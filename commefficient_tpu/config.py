@@ -1,0 +1,257 @@
+"""Configuration for CommEfficient-TPU.
+
+Keeps the reference's flag vocabulary (reference: CommEfficient/utils.py:102-230)
+so users of the original framework can carry their invocations over, but stores
+everything in a typed, hashable dataclass that can be closed over by ``jax.jit``
+(the reference threads an argparse Namespace through every function instead).
+
+TPU-specific additions: ``mesh_shape``/``mesh_axes`` for the device mesh,
+``param_dtype``/``compute_dtype`` for bfloat16 compute, and
+``max_client_batch`` (static per-client batch bound — XLA needs static shapes
+where the reference used dynamic per-client batches).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional, Tuple
+
+MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed")
+ERROR_TYPES = ("none", "local", "virtual")
+DP_MODES = ("worker", "server")
+
+# reference: CommEfficient/utils.py:37-44
+FED_DATASETS = {
+    "CIFAR10": 10,
+    "CIFAR100": 100,
+    "EMNIST": 62,
+    "ImageNet": 1000,
+    "PERSONA": -1,
+}
+
+
+def num_classes_of_dataset(dataset_name: str) -> int:
+    return FED_DATASETS[dataset_name]
+
+
+@dataclasses.dataclass(frozen=True)
+class FedConfig:
+    """Static configuration of a federated run.
+
+    Field names follow the reference flags (CommEfficient/utils.py:102-230);
+    ``do_*`` booleans keep the reference's argparse ``dest`` names.
+    """
+
+    # meta
+    mode: str = "sketch"
+    do_test: bool = False
+    use_tensorboard: bool = False
+    seed: int = 21
+
+    # data / model
+    model: str = "ResNet9"
+    dataset_name: str = "CIFAR10"
+    dataset_dir: str = "./dataset"
+    do_finetune: bool = False
+    do_checkpoint: bool = False
+    checkpoint_path: str = "./checkpoint"
+    finetune_path: str = "./finetune"
+    finetuned_from: Optional[str] = None
+    do_batchnorm: bool = False
+    num_results_train: int = 2
+    num_results_val: int = 2
+
+    # compression (reference defaults utils.py:142-147)
+    k: int = 50_000
+    num_cols: int = 500_000
+    num_rows: int = 5
+    num_blocks: int = 20
+    do_topk_down: bool = False
+
+    # optimization (reference defaults utils.py:150-162)
+    local_momentum: float = 0.9
+    virtual_momentum: float = 0.0
+    weight_decay: float = 5e-4
+    num_epochs: float = 24.0
+    num_fedavg_epochs: int = 1
+    fedavg_batch_size: int = -1
+    fedavg_lr_decay: float = 1.0
+    error_type: str = "none"
+    lr_scale: Optional[float] = 0.4
+    pivot_epoch: float = 5.0
+
+    # federation / parallelization
+    num_clients: Optional[int] = None
+    num_workers: int = 1          # clients sampled per round
+    do_iid: bool = False
+
+    # batching (reference utils.py:190-195)
+    local_batch_size: int = 8     # -1 => client's whole dataset
+    valid_batch_size: int = 8
+    microbatch_size: int = -1     # -1 => whole batch in one fwd/bwd
+
+    # GPT-2 (reference utils.py:183-207)
+    model_checkpoint: str = "gpt2"
+    num_candidates: int = 2
+    max_history: int = 2
+    lm_coef: float = 1.0
+    mc_coef: float = 1.0
+    max_grad_norm: Optional[float] = None
+    personality_permutations: int = 1
+    eval_before_start: bool = False
+
+    # differential privacy (reference utils.py:210-214)
+    do_dp: bool = False
+    dp_mode: str = "worker"
+    l2_norm_clip: float = 1.0
+    noise_multiplier: float = 0.0
+
+    # --- TPU-native additions (no reference equivalent) ---
+    mesh_shape: Tuple[int, ...] = ()      # () => single device
+    mesh_axes: Tuple[str, ...] = ("clients",)
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+    # static upper bound on a client's dataset size; used to pad
+    # `local_batch_size == -1` (whole-client) batches to a fixed shape
+    max_client_batch: int = 512
+    sketch_seed: int = 42
+
+    # filled in at model-build time, like the reference's args.grad_size
+    # (fed_aggregator.py:88). Frozen dataclass => use `replace`.
+    grad_size: int = 0
+
+    def __post_init__(self):
+        assert self.mode in MODES, self.mode
+        assert self.error_type in ERROR_TYPES, self.error_type
+        assert self.dp_mode in DP_MODES, self.dp_mode
+        if self.mode == "fedavg":
+            # reference invariants: utils.py:225-228
+            assert self.local_batch_size == -1
+            assert self.local_momentum == 0
+            assert self.error_type == "none"
+
+    def replace(self, **kw) -> "FedConfig":
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def transmitted_shape(self) -> Tuple[int, ...]:
+        """Shape of the quantity a client uploads (reference: fed_aggregator.py:116-121)."""
+        if self.mode == "sketch":
+            return (self.num_rows, self.num_cols)
+        return (self.grad_size,)
+
+    @property
+    def upload_floats(self) -> int:
+        """Floats uploaded per participating client per round
+        (reference byte table: fed_aggregator.py:291-299)."""
+        return {
+            "uncompressed": self.grad_size,
+            "true_topk": self.grad_size,
+            "local_topk": self.k,
+            "sketch": self.num_rows * self.num_cols,
+            "fedavg": self.grad_size,
+        }[self.mode]
+
+    @property
+    def needs_client_velocities(self) -> bool:
+        # reference: fed_aggregator.py:127-129
+        return self.local_momentum > 0
+
+    @property
+    def needs_client_errors(self) -> bool:
+        # reference: fed_aggregator.py:124-126
+        return self.error_type == "local"
+
+    def default_num_clients(self) -> int:
+        if self.num_clients is not None:
+            return self.num_clients
+        # reference hardcoded table: fed_aggregator.py:68-72. Like the
+        # reference, fail loudly (KeyError) for datasets with no natural
+        # client count (e.g. ImageNet) instead of inventing one.
+        defaults = {"EMNIST": 3500, "PERSONA": 17568,
+                    "CIFAR10": 10, "CIFAR100": 100}
+        return defaults[self.dataset_name]
+
+
+def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None):
+    """Reference flag surface (CommEfficient/utils.py:102-230), minus the
+    CUDA/process plumbing flags (--port, --device, --num_devices,
+    --share_ps_gpu, dataloader workers) that have no meaning in a
+    single-program SPMD runtime; plus TPU mesh flags."""
+    p = parser
+    p.add_argument("--test", action="store_true", dest="do_test")
+    p.add_argument("--mode", choices=MODES, default="sketch")
+    p.add_argument("--tensorboard", dest="use_tensorboard", action="store_true")
+    p.add_argument("--seed", type=int, default=21)
+
+    p.add_argument("--model", default="ResNet9")
+    p.add_argument("--finetune", action="store_true", dest="do_finetune")
+    p.add_argument("--checkpoint", action="store_true", dest="do_checkpoint")
+    p.add_argument("--checkpoint_path", type=str, default="./checkpoint")
+    p.add_argument("--finetune_path", type=str, default="./finetune")
+    p.add_argument("--finetuned_from", type=str, choices=list(FED_DATASETS))
+    p.add_argument("--num_results_train", type=int, default=2)
+    p.add_argument("--num_results_val", type=int, default=2)
+    p.add_argument("--dataset_name", type=str, default="CIFAR10",
+                   choices=list(FED_DATASETS))
+    p.add_argument("--dataset_dir", type=str, default="./dataset")
+    p.add_argument("--batchnorm", action="store_true", dest="do_batchnorm")
+
+    p.add_argument("--k", type=int, default=50_000)
+    p.add_argument("--num_cols", type=int, default=500_000)
+    p.add_argument("--num_rows", type=int, default=5)
+    p.add_argument("--num_blocks", type=int, default=20)
+    p.add_argument("--topk_down", action="store_true", dest="do_topk_down")
+
+    p.add_argument("--local_momentum", type=float, default=0.9)
+    p.add_argument("--virtual_momentum", type=float, default=0.0)
+    p.add_argument("--weight_decay", type=float, default=5e-4)
+    p.add_argument("--num_epochs", type=float, default=24)
+    p.add_argument("--num_fedavg_epochs", type=int, default=1)
+    p.add_argument("--fedavg_batch_size", type=int, default=-1)
+    p.add_argument("--fedavg_lr_decay", type=float, default=1.0)
+    p.add_argument("--error_type", choices=ERROR_TYPES, default="none")
+    p.add_argument("--lr_scale", type=float, default=default_lr)
+    p.add_argument("--pivot_epoch", type=float, default=5)
+
+    p.add_argument("--num_clients", type=int)
+    p.add_argument("--num_workers", type=int, default=1)
+    p.add_argument("--iid", action="store_true", dest="do_iid")
+
+    p.add_argument("--model_checkpoint", type=str, default="gpt2")
+    p.add_argument("--num_candidates", type=int, default=2)
+    p.add_argument("--max_history", type=int, default=2)
+    p.add_argument("--local_batch_size", type=int, default=8)
+    p.add_argument("--valid_batch_size", type=int, default=8)
+    p.add_argument("--microbatch_size", type=int, default=-1)
+    p.add_argument("--lm_coef", type=float, default=1.0)
+    p.add_argument("--mc_coef", type=float, default=1.0)
+    p.add_argument("--max_grad_norm", type=float)
+    p.add_argument("--personality_permutations", type=int, default=1)
+    p.add_argument("--eval_before_start", action="store_true")
+
+    p.add_argument("--dp", action="store_true", dest="do_dp")
+    p.add_argument("--dp_mode", choices=DP_MODES, default="worker")
+    p.add_argument("--l2_norm_clip", type=float, default=1.0)
+    p.add_argument("--noise_multiplier", type=float, default=0.0)
+
+    # TPU-native
+    p.add_argument("--mesh_shape", type=str, default="",
+                   help="comma-separated mesh, e.g. '4,2'; empty = single device")
+    p.add_argument("--mesh_axes", type=str, default="clients")
+    p.add_argument("--compute_dtype", type=str, default="bfloat16")
+    p.add_argument("--param_dtype", type=str, default="float32")
+    p.add_argument("--max_client_batch", type=int, default=512)
+    p.add_argument("--sketch_seed", type=int, default=42)
+    return parser
+
+
+def parse_args(argv=None, default_lr: Optional[float] = None) -> FedConfig:
+    parser = argparse.ArgumentParser()
+    add_args(parser, default_lr=default_lr)
+    ns = parser.parse_args(argv)
+    kw = vars(ns)
+    mesh_shape = tuple(int(x) for x in kw.pop("mesh_shape").split(",") if x)
+    mesh_axes = tuple(x for x in kw.pop("mesh_axes").split(",") if x)
+    return FedConfig(mesh_shape=mesh_shape, mesh_axes=mesh_axes, **kw)

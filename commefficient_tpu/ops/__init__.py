@@ -1,0 +1,23 @@
+from commefficient_tpu.ops.topk import topk, clip_by_l2_norm
+from commefficient_tpu.ops.pytree import ravel_params, make_unraveler
+from commefficient_tpu.ops.sketch import (
+    CountSketch,
+    make_sketch,
+    sketch_encode,
+    sketch_decode,
+    sketch_unsketch,
+    sketch_l2estimate,
+)
+
+__all__ = [
+    "topk",
+    "clip_by_l2_norm",
+    "ravel_params",
+    "make_unraveler",
+    "CountSketch",
+    "make_sketch",
+    "sketch_encode",
+    "sketch_decode",
+    "sketch_unsketch",
+    "sketch_l2estimate",
+]

@@ -1,0 +1,30 @@
+"""Flatten model parameter pytrees to the single contiguous fp32 vector the
+framework operates on, and back.
+
+Reference equivalent: CommEfficient/utils.py:261-297 (`get_param_vec` /
+`set_param_vec` / `get_grad_vec`), which loop over ``model.parameters()`` and
+``torch.cat`` the pieces. In JAX the canonical tool is
+``jax.flatten_util.ravel_pytree``; the unravel closure it returns is traceable,
+so flatten/unflatten happen *inside* the jitted round step with no host trips
+(the reference pays a host↔device copy per round, fed_worker.py:41).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def ravel_params(params: Any) -> Tuple[jax.Array, Callable[[jax.Array], Any]]:
+    """Return (flat fp32 vector, unravel closure)."""
+    flat, unravel = ravel_pytree(params)
+    return flat.astype(jnp.float32), unravel
+
+
+def make_unraveler(params: Any) -> Tuple[int, Callable[[jax.Array], Any]]:
+    """Return (grad_size, unravel closure) for a parameter pytree."""
+    flat, unravel = ravel_params(params)
+    return int(flat.size), unravel

@@ -1,0 +1,165 @@
+"""Count Sketch for gradient compression, XLA-native.
+
+Replaces the external ``csvec.CSVec`` CUDA package the reference depends on
+(call sites: CommEfficient/fed_worker.py:312-320, fed_aggregator.py:464-467,
+584-595, utils.py:309; the reference README says "To use sketching, you need
+to install https://github.com/nikitaivkin/csh").
+
+Semantics provided (matching the CSVec API surface):
+- ``sketch_encode``   ~ ``CSVec.accumulateVec`` from a zeroed table: hash each of
+  the d coordinates into one of c buckets per row with a ±1 sign, r rows.
+- table addition      ~ ``accumulateTable``: tables are plain arrays; the sketch
+  is LINEAR, so summing worker tables over the mesh (psum) equals sketching
+  the summed gradient — this is what makes FetchSGD aggregation work.
+- ``sketch_decode``   : median-of-r signed estimates for every coordinate.
+- ``sketch_unsketch`` ~ ``CSVec.unSketch(k)``: dense vector holding the top-k
+  estimated-magnitude coordinates (estimated values at those coordinates).
+- ``sketch_l2estimate`` ~ ``CSVec.l2estimate()``: median per-row table norm.
+
+TPU-first design decisions:
+- Hash/sign index tables are NEVER materialized at (r, d) size (for GPT-2,
+  d≈124M × r=5 would be 2.5 GB). Bucket/sign assignments are recomputed on the
+  fly from a murmur-style 32-bit integer mixer — pure vector ALU ops that XLA
+  fuses into the scatter/gather, trading negligible compute for HBM.
+- ``num_blocks`` chunks the coordinate axis; encode/decode ``lax.scan`` over
+  blocks so peak memory is O(d/num_blocks · r + r·c) regardless of d.
+- Encode is a per-row ``segment_sum`` (scatter-add); decode is a gather +
+  median. Both are static-shape and fully jittable/vmappable/shardable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from commefficient_tpu.ops.topk import topk
+
+_U32 = jnp.uint32
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class CountSketch:
+    """Hash-parameterization of a (d -> r x c) count sketch.
+
+    Holds only the per-row 32-bit hash keys; the table itself is an ordinary
+    ``(r, c)`` array owned by the caller (so it can live inside optimizer
+    state, be psum'd, etc.).
+    """
+
+    bucket_keys: jax.Array  # (r,) uint32
+    sign_keys: jax.Array    # (r,) uint32
+    d: int
+    c: int
+    r: int
+    num_blocks: int
+
+    def tree_flatten(self):
+        return (self.bucket_keys, self.sign_keys), (self.d, self.c, self.r, self.num_blocks)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+    @property
+    def block_len(self) -> int:
+        return -(-self.d // self.num_blocks)  # ceil
+
+    @property
+    def table_shape(self) -> Tuple[int, int]:
+        return (self.r, self.c)
+
+    def empty_table(self, dtype=jnp.float32) -> jax.Array:
+        return jnp.zeros(self.table_shape, dtype)
+
+
+def make_sketch(d: int, c: int, r: int, num_blocks: int = 1,
+                seed: int = 42) -> CountSketch:
+    """Build deterministic hash keys for a (d, c, r) count sketch.
+
+    Mirrors ``CSVec(d, c, r, numBlocks)`` (reference fed_aggregator.py:464-467)
+    except the device argument: placement is the caller's sharding concern.
+    """
+    rng = np.random.RandomState(seed)
+    bucket_keys = rng.randint(0, 2**32, size=(r,), dtype=np.uint64).astype(np.uint32) | 1
+    sign_keys = rng.randint(0, 2**32, size=(r,), dtype=np.uint64).astype(np.uint32) | 1
+    return CountSketch(jnp.asarray(bucket_keys), jnp.asarray(sign_keys),
+                       d=d, c=c, r=r, num_blocks=num_blocks)
+
+
+def _mix32(h: jax.Array) -> jax.Array:
+    """murmur3 fmix32 finalizer — avalanches all 32 bits so both the
+    low-bits-dependent ``% c`` bucket map and the high-bit sign are well mixed."""
+    h = h ^ (h >> 16)
+    h = h * _U32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * _U32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _buckets_signs(cs: CountSketch, idx: jax.Array):
+    """Per-row bucket ids and ±1 signs for global coordinate indices ``idx``.
+
+    Returns (buckets (r, n) int32 in [0, c), signs (r, n) float32 ±1).
+    """
+    idx = idx.astype(_U32)[None, :]
+    hb = _mix32(idx * cs.bucket_keys[:, None] + _U32(0x9E3779B9))
+    hs = _mix32(idx * cs.sign_keys[:, None] + _U32(0x85EBCA77))
+    buckets = (hb % _U32(cs.c)).astype(jnp.int32)
+    signs = (1.0 - 2.0 * (hs >> 31).astype(jnp.float32))
+    return buckets, signs
+
+
+def sketch_encode(cs: CountSketch, vec: jax.Array) -> jax.Array:
+    """Sketch a length-d vector into an (r, c) table (scatter-add per row)."""
+    assert vec.ndim == 1 and vec.shape[0] == cs.d, (vec.shape, cs.d)
+    bl, nb = cs.block_len, cs.num_blocks
+    vec_p = jnp.pad(vec.astype(jnp.float32), (0, bl * nb - cs.d))
+    blocks = vec_p.reshape(nb, bl)
+    base = jnp.arange(bl, dtype=_U32)
+
+    def body(table, args):
+        b_idx, block_vals = args
+        buckets, signs = _buckets_signs(cs, base + b_idx * _U32(bl))
+        vals = signs * block_vals[None, :]
+        contrib = jax.vmap(
+            lambda b, v: jax.ops.segment_sum(v, b, num_segments=cs.c)
+        )(buckets, vals)
+        return table + contrib, None
+
+    table, _ = lax.scan(body, cs.empty_table(),
+                        (jnp.arange(nb, dtype=_U32), blocks))
+    return table
+
+
+def sketch_decode(cs: CountSketch, table: jax.Array) -> jax.Array:
+    """Median-of-r estimate of every coordinate; returns a dense (d,) vector."""
+    assert table.shape == cs.table_shape, (table.shape, cs.table_shape)
+    bl, nb = cs.block_len, cs.num_blocks
+    base = jnp.arange(bl, dtype=_U32)
+    rows = jnp.arange(cs.r)[:, None]
+
+    def body(_, b_idx):
+        buckets, signs = _buckets_signs(cs, base + b_idx * _U32(bl))
+        ests = signs * table[rows, buckets]       # (r, bl)
+        return None, jnp.median(ests, axis=0)     # (bl,)
+
+    _, ests = lax.scan(body, None, jnp.arange(nb, dtype=_U32))
+    return ests.reshape(-1)[: cs.d]
+
+
+def sketch_unsketch(cs: CountSketch, table: jax.Array, k: int) -> jax.Array:
+    """Top-k heavy-hitter recovery: dense (d,) vector, nonzero only at the k
+    coordinates with the largest estimated magnitude (= ``CSVec.unSketch(k)``)."""
+    return topk(sketch_decode(cs, table), k)
+
+
+def sketch_l2estimate(cs: CountSketch, table: jax.Array) -> jax.Array:
+    """Estimate of the L2 norm of the sketched vector (= ``CSVec.l2estimate``)."""
+    return jnp.median(jnp.linalg.norm(table, axis=1))

@@ -1,0 +1,56 @@
+"""Magnitude top-k sparsification and L2 clipping, XLA-native.
+
+Reference behavior: CommEfficient/utils.py:232-252 (`_topk`) selects the k
+largest-magnitude entries (by squared value) and returns a dense vector that
+is zero elsewhere; supports 1-D vectors and row-wise 2-D. The reference works
+around CUDA ``topk`` NaN bugs with zero-initialized output buffers
+(utils.py:239-244); under XLA ``lax.top_k`` is deterministic so no workaround
+is needed — we instead express the densify step as a scatter, which XLA lowers
+efficiently on TPU.
+
+``clip_by_l2_norm`` mirrors CommEfficient/utils.py:305-313 (`clip_grad`) but
+as a branch-free `where` so it stays inside ``jit``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _topk_1d(vec: jax.Array, k: int) -> jax.Array:
+    _, idx = lax.top_k(vec * vec, k)
+    return jnp.zeros_like(vec).at[idx].set(vec[idx])
+
+
+def topk(vec: jax.Array, k: int) -> jax.Array:
+    """Dense vector keeping only the k largest-magnitude entries.
+
+    1-D: top-k over the whole vector. 2-D: row-wise top-k (each row keeps its
+    own k entries), matching reference utils.py:249-252.
+    """
+    if vec.ndim == 1:
+        return _topk_1d(vec, k)
+    if vec.ndim == 2:
+        return jax.vmap(lambda row: _topk_1d(row, k))(vec)
+    raise ValueError(f"topk supports 1-D/2-D, got shape {vec.shape}")
+
+
+def clip_by_l2_norm(record: jax.Array, clip: float) -> jax.Array:
+    """Scale ``record`` down to L2 norm ``clip`` if it exceeds it.
+
+    Matches reference ``clip_grad`` (utils.py:305-313): dense vectors are
+    clipped by their true L2 norm; count-sketch tables (2-D) are clipped by
+    the sketch's *estimate* of the vector norm — the median per-row table
+    norm (``l2estimate()`` in csvec) — NOT the Frobenius norm, which is
+    ~sqrt(r) larger and would over-clip. Scaling the table scales every
+    row-norm estimate by the same factor, so the clipped table's estimated
+    norm equals ``clip``.
+    """
+    if record.ndim == 2:
+        l2 = jnp.median(jnp.linalg.norm(record, axis=1))
+    else:
+        l2 = jnp.linalg.norm(record)
+    scale = jnp.where(l2 > clip, clip / jnp.maximum(l2, 1e-12), 1.0)
+    return record * scale.astype(record.dtype)

@@ -1,0 +1,156 @@
+"""Unit tests for compression kernels: top-k, clipping, CountSketch.
+
+Property tests follow SURVEY.md §4's implications: sketch linearity,
+heavy-hitter recovery, lossless-limit equivalence with exact top-k.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.ops import (
+    clip_by_l2_norm,
+    make_sketch,
+    sketch_decode,
+    sketch_encode,
+    sketch_l2estimate,
+    sketch_unsketch,
+    topk,
+)
+
+
+class TestTopk:
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        vec = rng.randn(1000).astype(np.float32)
+        k = 17
+        out = np.asarray(topk(jnp.asarray(vec), k))
+        # nonzero exactly at the k largest |v|
+        order = np.argsort(vec**2)[::-1][:k]
+        expected = np.zeros_like(vec)
+        expected[order] = vec[order]
+        np.testing.assert_allclose(out, expected)
+
+    def test_2d_rowwise(self):
+        rng = np.random.RandomState(1)
+        mat = rng.randn(4, 100).astype(np.float32)
+        out = np.asarray(topk(jnp.asarray(mat), 5))
+        for i in range(4):
+            assert (out[i] != 0).sum() == 5
+            kept = np.abs(mat[i])[out[i] != 0].min()
+            dropped = np.abs(mat[i])[out[i] == 0].max()
+            assert kept >= dropped
+
+    def test_jit(self):
+        vec = jnp.arange(10.0) - 5.0
+        out = jax.jit(lambda v: topk(v, 3))(vec)
+        assert int((out != 0).sum()) == 3
+
+
+class TestClip:
+    def test_noop_below_threshold(self):
+        v = jnp.array([0.3, 0.4])  # norm 0.5
+        np.testing.assert_allclose(np.asarray(clip_by_l2_norm(v, 1.0)), [0.3, 0.4])
+
+    def test_scales_above_threshold(self):
+        v = jnp.array([3.0, 4.0])  # norm 5
+        out = np.asarray(clip_by_l2_norm(v, 1.0))
+        np.testing.assert_allclose(np.linalg.norm(out), 1.0, rtol=1e-6)
+        np.testing.assert_allclose(out, [0.6, 0.8], rtol=1e-6)
+
+    def test_sketch_table_uses_l2estimate(self):
+        """Clipping a sketch table must clip by the median row norm
+        (csvec l2estimate semantics, reference utils.py:305-313), not the
+        Frobenius norm — the clipped table's estimate equals the threshold."""
+        rng = np.random.RandomState(9)
+        cs2 = make_sketch(d=D, c=C, r=R, num_blocks=1, seed=13)
+        v = jnp.asarray((rng.randn(D) * 3).astype(np.float32))
+        table = sketch_encode(cs2, v)
+        est_before = float(sketch_l2estimate(cs2, table))
+        clip = est_before / 2
+        clipped = clip_by_l2_norm(table, clip)
+        np.testing.assert_allclose(float(sketch_l2estimate(cs2, clipped)),
+                                   clip, rtol=1e-5)
+
+
+D, C, R = 5000, 2000, 5
+
+
+@pytest.fixture(scope="module")
+def cs():
+    return make_sketch(d=D, c=C, r=R, num_blocks=4, seed=7)
+
+
+class TestSketch:
+    def test_linearity(self, cs):
+        rng = np.random.RandomState(2)
+        a = jnp.asarray(rng.randn(D).astype(np.float32))
+        b = jnp.asarray(rng.randn(D).astype(np.float32))
+        t = sketch_encode(cs, a) + sketch_encode(cs, b)
+        t_sum = sketch_encode(cs, a + b)
+        np.testing.assert_allclose(np.asarray(t), np.asarray(t_sum), atol=1e-4)
+
+    def test_block_invariance(self):
+        """Table must not depend on num_blocks (it is a memory knob only)."""
+        rng = np.random.RandomState(3)
+        v = jnp.asarray(rng.randn(D).astype(np.float32))
+        t1 = sketch_encode(make_sketch(D, C, R, num_blocks=1, seed=7), v)
+        t4 = sketch_encode(make_sketch(D, C, R, num_blocks=4, seed=7), v)
+        t7 = sketch_encode(make_sketch(D, C, R, num_blocks=7, seed=7), v)
+        np.testing.assert_allclose(np.asarray(t1), np.asarray(t4), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(t1), np.asarray(t7), atol=1e-4)
+
+    def test_heavy_hitter_recovery(self, cs):
+        """A vector with k big spikes + small noise: unsketch finds the spikes."""
+        rng = np.random.RandomState(4)
+        k = 10
+        v = rng.randn(D).astype(np.float32) * 0.01
+        spikes = rng.choice(D, k, replace=False)
+        v[spikes] = np.sign(rng.randn(k)) * (10.0 + rng.rand(k))
+        table = sketch_encode(cs, jnp.asarray(v))
+        rec = np.asarray(sketch_unsketch(cs, table, k))
+        assert set(np.nonzero(rec)[0]) == set(spikes)
+        np.testing.assert_allclose(rec[spikes], v[spikes], rtol=0.05, atol=0.1)
+
+    def test_lossless_limit_matches_topk(self):
+        """With a huge table (c >> d), estimates ≈ exact values, so
+        unsketch(k) must equal exact topk(k) (SURVEY.md §4 golden strategy)."""
+        d = 200
+        cs_big = make_sketch(d=d, c=50_000, r=7, num_blocks=1, seed=11)
+        rng = np.random.RandomState(5)
+        v = jnp.asarray(rng.randn(d).astype(np.float32))
+        table = sketch_encode(cs_big, v)
+        est = np.asarray(sketch_decode(cs_big, table))
+        np.testing.assert_allclose(est, np.asarray(v), atol=1e-3)
+        rec = np.asarray(sketch_unsketch(cs_big, table, 20))
+        exact = np.asarray(topk(v, 20))
+        np.testing.assert_allclose(rec, exact, atol=1e-3)
+
+    def test_l2_estimate(self, cs):
+        rng = np.random.RandomState(6)
+        v = jnp.asarray(rng.randn(D).astype(np.float32))
+        table = sketch_encode(cs, v)
+        est = float(sketch_l2estimate(cs, table))
+        true = float(jnp.linalg.norm(v))
+        assert abs(est - true) / true < 0.15
+
+    def test_encode_jit_and_vmap(self, cs):
+        rng = np.random.RandomState(8)
+        vs = jnp.asarray(rng.randn(3, D).astype(np.float32))
+        tables = jax.jit(jax.vmap(lambda v: sketch_encode(cs, v)))(vs)
+        assert tables.shape == (3, R, C)
+        # vmapped encode must agree with single encode
+        single = sketch_encode(cs, vs[1])
+        np.testing.assert_allclose(np.asarray(tables[1]), np.asarray(single),
+                                   atol=1e-4)
+
+    def test_sign_balance(self, cs):
+        """Hash quality smoke check: bucket histogram ~uniform, signs ~balanced."""
+        from commefficient_tpu.ops.sketch import _buckets_signs
+        idx = jnp.arange(D, dtype=jnp.uint32)
+        buckets, signs = _buckets_signs(cs, idx)
+        assert float(jnp.abs(signs.mean())) < 0.05
+        counts = np.bincount(np.asarray(buckets[0]), minlength=C)
+        # expected D/C per bucket = 2.5; max shouldn't explode
+        assert counts.max() < 15
