@@ -107,6 +107,10 @@ class FedConfig:
     l2_norm_clip: float = 1.0
     noise_multiplier: float = 0.0
 
+    # simulated per-client communication byte tracking (the reference always
+    # tracks; here it can be disabled for pure-throughput benchmarks)
+    track_bytes: bool = True
+
     # --- TPU-native additions (no reference equivalent) ---
     mesh_shape: Tuple[int, ...] = ()      # () => single device
     mesh_axes: Tuple[str, ...] = ("clients",)
@@ -235,6 +239,9 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--dp_mode", choices=DP_MODES, default="worker")
     p.add_argument("--l2_norm_clip", type=float, default=1.0)
     p.add_argument("--noise_multiplier", type=float, default=0.0)
+
+    p.add_argument("--no_track_bytes", dest="track_bytes",
+                   action="store_false", default=True)
 
     # TPU-native
     p.add_argument("--mesh_shape", type=str, default="",

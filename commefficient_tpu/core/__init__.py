@@ -1,0 +1,5 @@
+from commefficient_tpu.core.server import server_update, validate_mode_combo
+from commefficient_tpu.core.state import FedState
+from commefficient_tpu.core.runtime import FedRuntime
+
+__all__ = ["server_update", "validate_mode_combo", "FedState", "FedRuntime"]

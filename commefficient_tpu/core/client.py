@@ -1,0 +1,277 @@
+"""Client-side computation: microbatched gradients, local compression state,
+and the FedAvg local-SGD loop.
+
+Re-designs CommEfficient/fed_worker.py (process_batch / local_step /
+forward_grad / the fedavg branch of worker_loop) as pure functions over a
+*static-shape* per-client batch. The reference runs a Python loop over
+variable-size client batches inside worker processes; here every client batch
+is padded to a fixed shape with a validity mask, microbatching is a
+``lax.scan``, and the whole per-client step is ``vmap``-ed (or shard_map-ed)
+over the round's client axis by the runtime.
+
+Loss-function contract
+----------------------
+``loss_fn(params_pytree, batch_pytree, mask) -> (mean_loss, metrics_tuple)``
+where every leaf of ``batch_pytree`` has a leading batch axis, ``mask`` is a
+float/bool validity vector over that axis, and ``mean_loss``/metrics are means
+over *valid* items. (The reference's ``compute_loss_train`` returns
+``(loss, *metrics)``, cv_train.py:67-83.)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.ops import clip_by_l2_norm, topk
+from commefficient_tpu.ops.sketch import CountSketch, sketch_encode
+
+
+class ClientOut(NamedTuple):
+    transmit: jax.Array                # transmitted-space quantity, x n_c
+    velocity: Optional[jax.Array]      # updated local velocity row (or None)
+    error: Optional[jax.Array]         # updated local error row (or None)
+    results: Tuple[jax.Array, ...]     # (mean_loss, *metrics) over the batch
+    n_valid: jax.Array                 # () number of valid datums processed
+
+
+def _num_microbatches(cfg: FedConfig, batch_size: int) -> Tuple[int, int]:
+    if cfg.microbatch_size > 0:
+        mb = min(batch_size, cfg.microbatch_size)
+    else:
+        mb = batch_size
+    return math.ceil(batch_size / mb), mb
+
+
+def make_forward_grad(
+    cfg: FedConfig,
+    loss_fn: Callable,
+    unravel: Callable[[jax.Array], Any],
+    batch_size: int,
+    cs: Optional[CountSketch] = None,
+):
+    """Build the microbatched forward/backward (reference fed_worker.py:249-335).
+
+    Returns ``fwd(params_vec, batch, mask, rng) -> (g, results, n_valid)``
+    where ``g`` is in transmitted space: the accumulated sum over microbatches
+    of per-microbatch mean gradients (matching the reference's
+    ``loss.backward()`` accumulation), with decoupled weight decay
+    ``wd/num_workers * w`` added (reference utils.py:254-259), grad-norm
+    clipping, optional DP clip+noise, and mode compression (sketch encode).
+    """
+    num_iters, mb = _num_microbatches(cfg, batch_size)
+    pad_to = num_iters * mb
+    if cfg.mode == "sketch":
+        assert cs is not None, "sketch mode requires the runtime's CountSketch"
+
+    def loss_on_vec(vec, mb_batch, mb_mask):
+        loss, metrics = loss_fn(unravel(vec), mb_batch, mb_mask)
+        return loss, metrics
+
+    grad_fn = jax.value_and_grad(loss_on_vec, has_aux=True)
+
+    def fwd(params_vec, batch, mask, rng):
+        mask = mask.astype(jnp.float32)
+        if pad_to != batch_size:
+            pad = pad_to - batch_size
+            batch = jax.tree.map(
+                lambda t: jnp.pad(t, [(0, pad)] + [(0, 0)] * (t.ndim - 1)),
+                batch)
+            mask = jnp.pad(mask, (0, pad))
+        micro_batches = jax.tree.map(
+            lambda t: t.reshape((num_iters, mb) + t.shape[1:]), batch)
+        micro_masks = mask.reshape(num_iters, mb)
+
+        def body(carry, inp):
+            g_acc, loss_acc, metrics_acc = carry
+            mb_batch, mb_mask = inp
+            (loss, metrics), g = grad_fn(params_vec, mb_batch, mb_mask)
+            w = mb_mask.sum()
+            metrics_acc = jax.tree.map(
+                lambda a, m: a + m * w, metrics_acc, tuple(metrics))
+            return (g_acc + g, loss_acc + loss * w, metrics_acc), None
+
+        # probe metrics structure without running the model twice: metrics
+        # accumulators start at zero scalars shaped like the loss outputs
+        metrics_zero = tuple(
+            jnp.zeros(()) for _ in range(cfg.num_results_train - 1))
+        init = (jnp.zeros_like(params_vec), jnp.zeros(()), metrics_zero)
+        (g, loss_sum, metrics_sum), _ = lax.scan(
+            body, init, (micro_batches, micro_masks))
+
+        n_valid = mask.sum()
+        denom = jnp.maximum(n_valid, 1.0)
+        results = (loss_sum / denom,) + tuple(
+            m / denom for m in metrics_sum)
+
+        # decoupled weight decay (reference utils.py:254-259)
+        if cfg.weight_decay != 0:
+            g = g + (cfg.weight_decay / cfg.num_workers) * params_vec
+        # grad-norm clipping for dense modes (reference fed_worker.py:290-292;
+        # threshold scales with the number of accumulation steps)
+        if cfg.max_grad_norm is not None and cfg.mode != "sketch":
+            g = clip_by_l2_norm(g, cfg.max_grad_norm * num_iters)
+        # differential privacy (reference fed_worker.py:304-309)
+        if cfg.do_dp:
+            g = clip_by_l2_norm(g, cfg.l2_norm_clip)
+            if cfg.dp_mode == "worker":
+                noise = cfg.noise_multiplier * jnp.sqrt(
+                    1.0 * cfg.num_workers) * jax.random.normal(
+                        rng, g.shape, g.dtype)
+                g = g + noise
+        # mode compression (reference fed_worker.py:312-333)
+        if cfg.mode == "sketch":
+            table = sketch_encode(cs, g)
+            if cfg.max_grad_norm is not None:
+                table = clip_by_l2_norm(table, cfg.max_grad_norm)
+            g = table
+        return g, results, n_valid
+
+    return fwd
+
+
+def make_client_step(
+    cfg: FedConfig,
+    loss_fn: Callable,
+    unravel: Callable[[jax.Array], Any],
+    batch_size: int,
+    cs: Optional[CountSketch] = None,
+):
+    """Single-round client step: forward_grad + local momentum / error /
+    local-topk pipeline (reference fed_worker.py:184-230).
+
+    Returns ``step(params_vec, batch, mask, velocity, error, rng) -> ClientOut``.
+    ``velocity``/``error`` are this client's persistent rows (or None when the
+    mode doesn't allocate them, reference fed_aggregator.py:105-129).
+    """
+    fwd = make_forward_grad(cfg, loss_fn, unravel, batch_size, cs)
+
+    def step(params_vec, batch, mask, velocity, error, rng) -> ClientOut:
+        g, results, n_valid = fwd(params_vec, batch, mask, rng)
+        # weight by datum count: the server divides by the round's total
+        # (reference fed_worker.py:190, fed_aggregator.py:332)
+        g = g * n_valid
+
+        new_velocity, new_error = velocity, error
+        if cfg.local_momentum > 0:
+            new_velocity = cfg.local_momentum * velocity + g
+            base = new_velocity
+        else:
+            base = g
+
+        if cfg.error_type == "local":
+            new_error = error + base
+            to_transmit = new_error
+        else:
+            to_transmit = base
+
+        if cfg.mode == "local_topk":
+            to_transmit = topk(to_transmit, k=cfg.k)
+            nz = to_transmit != 0
+            if new_error is not None:
+                new_error = jnp.where(nz, 0.0, new_error)   # error feedback
+            if cfg.local_momentum > 0:
+                new_velocity = jnp.where(nz, 0.0, new_velocity)  # factor mask
+
+        return ClientOut(to_transmit, new_velocity, new_error, results, n_valid)
+
+    return step
+
+
+def make_fedavg_client(
+    cfg: FedConfig,
+    loss_fn: Callable,
+    unravel: Callable[[jax.Array], Any],
+    batch_size: int,
+    cs: Optional[CountSketch] = None,
+):
+    """FedAvg local-SGD loop (reference fed_worker.py:61-113).
+
+    The client's whole (padded) dataset arrives as one batch; it is split
+    into ``fedavg_batch_size`` chunks, trained for ``num_fedavg_epochs``
+    epochs of local SGD with per-step decay ``fedavg_lr_decay**step``, and
+    the dataset-size-weighted weight delta is transmitted.
+
+    Returns ``step(params_vec, batch, mask, lr, rng) -> ClientOut``.
+    """
+    if cfg.fedavg_batch_size == -1:
+        chunk = batch_size
+    else:
+        chunk = min(cfg.fedavg_batch_size, batch_size)
+    n_chunks = math.ceil(batch_size / chunk)
+    pad_to = n_chunks * chunk
+    fwd = make_forward_grad(cfg, loss_fn, unravel, chunk, cs)
+
+    def step(params_vec, batch, mask, lr, rng) -> ClientOut:
+        mask = mask.astype(jnp.float32)
+        n_c = mask.sum()
+        if pad_to != batch_size:
+            pad = pad_to - batch_size
+            batch = jax.tree.map(
+                lambda t: jnp.pad(t, [(0, pad)] + [(0, 0)] * (t.ndim - 1)),
+                batch)
+            mask = jnp.pad(mask, (0, pad))
+        chunks = jax.tree.map(
+            lambda t: t.reshape((n_chunks, chunk) + t.shape[1:]), batch)
+        chunk_masks = mask.reshape(n_chunks, chunk)
+
+        n_steps = n_chunks * cfg.num_fedavg_epochs
+        rngs = jax.random.split(rng, n_steps).reshape(
+            (cfg.num_fedavg_epochs, n_chunks) + rng.shape)
+
+        def chunk_body(carry, inp):
+            w, step_idx, res_acc = carry
+            c_batch, c_mask, c_rng = inp
+            g, results, n_valid = fwd(w, c_batch, c_mask, c_rng)
+            # g is the (possibly multi-microbatch) mean-gradient sum; the
+            # reference divides the transmitted sum back by the chunk size
+            # before stepping (fed_worker.py:96-100) — our fwd already
+            # returns the per-chunk mean accumulation, so apply it directly.
+            decay = cfg.fedavg_lr_decay ** step_idx
+            w = w - g * lr * decay
+            res_acc = jax.tree.map(lambda a, r: a + r, res_acc, tuple(results))
+            return (w, step_idx + 1.0, res_acc), None
+
+        def epoch_body(carry, epoch_rngs):
+            # inner scan closes over the one resident copy of the chunks
+            # (reference's epoch x chunk loops, fed_worker.py:82-101)
+            carry, _ = lax.scan(chunk_body, carry,
+                                (chunks, chunk_masks, epoch_rngs))
+            return carry, None
+
+        res_zero = tuple(jnp.zeros(()) for _ in range(cfg.num_results_train))
+        (w_final, _, res_acc), _ = lax.scan(
+            epoch_body, (params_vec, 0.0, res_zero), rngs)
+
+        results = tuple(r / n_steps for r in res_acc)
+        # dataset-size weighting (reference fed_worker.py:104-108)
+        transmit = (params_vec - w_final) * n_c
+        return ClientOut(transmit, None, None, results, n_c)
+
+    return step
+
+
+def make_val_step(cfg: FedConfig, loss_fn: Callable,
+                  unravel: Callable[[jax.Array], Any]):
+    """Masked evaluation (reference fed_worker.py:179-181 with
+    compute_grad=False): returns (results_tuple, n_valid)."""
+
+    def val(params_vec, batch, mask):
+        mask = mask.astype(jnp.float32)
+        loss, metrics = loss_fn(unravel(params_vec), batch, mask)
+        return (loss,) + tuple(metrics), mask.sum()
+
+    return val
+
+
+def topk_down_weights(cfg: FedConfig, ps_weights: jax.Array,
+                      worker_weights: jax.Array) -> jax.Array:
+    """Download-compression emulation (reference fed_worker.py:232-247):
+    the client's stale weights advance by the top-k of its lag."""
+    diff = ps_weights - worker_weights
+    return worker_weights + topk(diff, k=cfg.k)

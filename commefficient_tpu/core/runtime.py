@@ -1,0 +1,247 @@
+"""FedRuntime: the single-program federated round.
+
+This is the TPU-native collapse of the reference's entire process
+architecture (SURVEY.md §2.8): the parameter-server process
+(fed_aggregator.py), the per-GPU worker processes (fed_worker.py), the
+batch/result multiprocessing queues, the /dev/shm shared-memory tensors and
+the NCCL reduce all become ONE jitted function
+
+    round_step(state: FedState, client_ids, batch, mask, lr)
+        -> (state', metrics)
+
+in which the round's clients are a leading array axis. Per-client gradients
+are computed under ``vmap`` (single device) or ``shard_map`` over the
+``clients`` mesh axis with a ``psum`` aggregation (see parallel/), which is
+the ICI equivalent of the reference's ``torch.distributed.reduce(sum_g, 0)``
+(fed_worker.py:138, fed_aggregator.py:329).
+
+State stays on device between rounds; the only host traffic is the incoming
+batch and the outgoing scalar metrics — the reference instead bounces the
+full weight vector host<->device every round (fed_worker.py:41,
+fed_aggregator.py:455).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.core import client as client_lib
+from commefficient_tpu.core.server import server_update, validate_mode_combo
+from commefficient_tpu.core.state import FedState
+from commefficient_tpu.ops import ravel_params
+from commefficient_tpu.ops.sketch import make_sketch
+
+
+class FedRuntime:
+    """Owns the jitted round/val steps and the state layout for a model.
+
+    Parameters
+    ----------
+    cfg : FedConfig (grad_size is filled in here, like fed_aggregator.py:88)
+    params : the model parameter pytree (initial weights)
+    loss_fn_train / loss_fn_val : see core.client loss contract
+    batch_size : static per-client batch (local_batch_size, or
+        max_client_batch when local_batch_size == -1)
+    num_clients : total simulated clients
+    """
+
+    def __init__(self, cfg: FedConfig, params: Any,
+                 loss_fn_train: Callable,
+                 loss_fn_val: Optional[Callable] = None,
+                 num_clients: Optional[int] = None):
+        flat, unravel = ravel_params(params)
+        cfg = cfg.replace(grad_size=int(flat.size))
+        validate_mode_combo(cfg)
+        self.cfg = cfg
+        self.unravel = unravel
+        self.initial_weights = flat
+        self.num_clients = (num_clients if num_clients is not None
+                            else cfg.default_num_clients())
+        self.batch_size = (cfg.local_batch_size if cfg.local_batch_size > 0
+                           else cfg.max_client_batch)
+        self.cs = None
+        if cfg.mode == "sketch":
+            self.cs = make_sketch(cfg.grad_size, cfg.num_cols, cfg.num_rows,
+                                  cfg.num_blocks, seed=cfg.sketch_seed)
+
+        loss_fn_val = loss_fn_val if loss_fn_val is not None else loss_fn_train
+        if cfg.mode == "fedavg":
+            self._client_fn = client_lib.make_fedavg_client(
+                cfg, loss_fn_train, unravel, self.batch_size, self.cs)
+        else:
+            self._client_fn = client_lib.make_client_step(
+                cfg, loss_fn_train, unravel, self.batch_size, self.cs)
+        self._val_fn_inner = client_lib.make_val_step(cfg, loss_fn_val, unravel)
+
+        self._round = jax.jit(self._round_step, donate_argnums=(0,))
+        self._val = jax.jit(self._val_step)
+
+    # ------------------------------------------------------------------ state
+
+    def init_state(self, seed: Optional[int] = None) -> FedState:
+        cfg = self.cfg
+        tx = cfg.transmitted_shape
+        d = cfg.grad_size
+        n = self.num_clients
+        zeros_tx = jnp.zeros(tx, jnp.float32)
+
+        def maybe(shape, cond):
+            return jnp.zeros(shape, jnp.float32) if cond else None
+
+        return FedState(
+            ps_weights=self.initial_weights,
+            Vvelocity=zeros_tx,
+            Verror=jnp.zeros_like(zeros_tx),
+            step=jnp.zeros((), jnp.int32),
+            rng=jax.random.PRNGKey(cfg.seed if seed is None else seed),
+            client_velocities=maybe((n,) + tx, cfg.needs_client_velocities),
+            client_errors=maybe((n,) + tx, cfg.needs_client_errors),
+            # every client starts with the initial PS weights
+            # (reference fed_aggregator.py:105-111)
+            client_weights=(jnp.broadcast_to(self.initial_weights, (n, d))
+                            if cfg.do_topk_down else None),
+            coord_last_update=(jnp.full((d,), -1, jnp.int32)
+                               if cfg.track_bytes else None),
+            client_last_round=(jnp.zeros((n,), jnp.int32)
+                               if cfg.track_bytes else None),
+        )
+
+    # ------------------------------------------------------------- round step
+
+    def _round_step(self, state: FedState, client_ids: jax.Array,
+                    batch: Any, mask: jax.Array, lr: jax.Array):
+        cfg = self.cfg
+        num_workers = client_ids.shape[0]
+        keys = jax.random.split(state.rng, num_workers + 2)
+        rng, server_rng, client_rngs = keys[0], keys[1], keys[2:]
+
+        # ---- download byte accounting, before this round's update
+        # (re-design of reference fed_aggregator.py:239-289; see state.py)
+        download_bytes = upload_bytes = None
+        client_last_round = state.client_last_round
+        if cfg.track_bytes:
+            thresholds = state.client_last_round[client_ids]
+            counts = lax.map(
+                lambda t: (state.coord_last_update >= t).sum(), thresholds)
+            download_bytes = jnp.zeros(self.num_clients, jnp.float32).at[
+                client_ids].set(4.0 * counts.astype(jnp.float32))
+            upload_bytes = jnp.zeros(self.num_clients, jnp.float32).at[
+                client_ids].set(4.0 * cfg.upload_floats)
+            client_last_round = state.client_last_round.at[client_ids].set(
+                state.step)
+
+        # ---- per-client weights (download path)
+        client_weights = state.client_weights
+        if cfg.do_topk_down:
+            stale = state.client_weights[client_ids]
+            used_weights = jax.vmap(
+                lambda w: client_lib.topk_down_weights(
+                    cfg, state.ps_weights, w))(stale)
+            client_weights = state.client_weights.at[client_ids].set(
+                used_weights)
+            params_axis = 0
+        else:
+            # all clients read the current PS weights
+            # (reference fed_worker.py:159)
+            used_weights = state.ps_weights
+            params_axis = None
+
+        # ---- per-client persistent rows
+        vel_rows = (state.client_velocities[client_ids]
+                    if state.client_velocities is not None else None)
+        err_rows = (state.client_errors[client_ids]
+                    if state.client_errors is not None else None)
+
+        # ---- client compute, vmapped over the round's client axis
+        if cfg.mode == "fedavg":
+            out = jax.vmap(
+                self._client_fn,
+                in_axes=(params_axis, 0, 0, None, 0))(
+                    used_weights, batch, mask, lr, client_rngs)
+        else:
+            out = jax.vmap(
+                self._client_fn,
+                in_axes=(params_axis, 0, 0,
+                         0 if vel_rows is not None else None,
+                         0 if err_rows is not None else None, 0))(
+                    used_weights, batch, mask, vel_rows, err_rows,
+                    client_rngs)
+
+        # ---- aggregate: sum over clients / total datums
+        # (reference fed_worker.py:131,138 + fed_aggregator.py:329-332)
+        total = jnp.maximum(out.n_valid.sum(), 1.0)
+        agg = out.transmit.sum(axis=0) / total
+
+        # ---- server update
+        server_lr = jnp.asarray(1.0) if cfg.mode == "fedavg" else lr
+        update, Vvel, Verr, sup_mask = server_update(
+            cfg, agg, state.Vvelocity, state.Verror, server_lr,
+            cs=self.cs, dp_rng=server_rng)
+        ps_weights = state.ps_weights - update
+
+        # ---- write back per-client rows
+        client_velocities = state.client_velocities
+        if out.velocity is not None and client_velocities is not None:
+            new_rows = out.velocity
+            if cfg.mode == "true_topk" and sup_mask is not None:
+                # momentum factor masking on participating clients' local
+                # velocities (intended behavior of fed_aggregator.py:528-533)
+                new_rows = jnp.where(sup_mask[None, :], 0.0, new_rows)
+            client_velocities = client_velocities.at[client_ids].set(new_rows)
+        client_errors = state.client_errors
+        if out.error is not None and client_errors is not None:
+            client_errors = client_errors.at[client_ids].set(out.error)
+
+        # ---- byte accounting: record which coordinates changed this round
+        coord_last_update = state.coord_last_update
+        if cfg.track_bytes:
+            coord_last_update = jnp.where(
+                update != 0, state.step, state.coord_last_update)
+
+        new_state = FedState(
+            ps_weights=ps_weights,
+            Vvelocity=Vvel,
+            Verror=Verr,
+            step=state.step + 1,
+            rng=rng,
+            client_velocities=client_velocities,
+            client_errors=client_errors,
+            client_weights=client_weights,
+            coord_last_update=coord_last_update,
+            client_last_round=client_last_round,
+        )
+        metrics = {
+            "results": out.results,          # tuple of (num_workers,) arrays
+            "n_valid": out.n_valid,
+            "download_bytes": download_bytes,
+            "upload_bytes": upload_bytes,
+        }
+        return new_state, metrics
+
+    def _val_step(self, ps_weights: jax.Array, batch: Any, mask: jax.Array):
+        return self._val_fn_inner(ps_weights, batch, mask)
+
+    # -------------------------------------------------------------- user API
+
+    def round(self, state: FedState, client_ids, batch, mask, lr
+              ) -> Tuple[FedState, Dict]:
+        """Run one federated round. ``client_ids``: (num_workers,) int32;
+        ``batch``: pytree with leaves (num_workers, batch_size, ...);
+        ``mask``: (num_workers, batch_size); ``lr``: scalar or (d,) vector."""
+        return self._round(state, jnp.asarray(client_ids, jnp.int32), batch,
+                           jnp.asarray(mask), jnp.asarray(lr, jnp.float32))
+
+    def val(self, state: FedState, batch, mask):
+        """Masked evaluation on the current PS weights; returns
+        (results_tuple, n_valid)."""
+        return self._val(state.ps_weights, batch, jnp.asarray(mask))
+
+    def get_params(self, state: FedState):
+        """Materialize the model parameter pytree from the flat PS weights
+        (reference __getattr__ trick, fed_aggregator.py:372-376)."""
+        return self.unravel(state.ps_weights)

@@ -1,0 +1,145 @@
+"""Server-side update rules for the five federated modes.
+
+Pure-functional re-design of the reference's ``get_server_update`` dispatch
+and ``_server_helper_*`` family (CommEfficient/fed_aggregator.py:469-613).
+The reference mutates momentum/error buffers in place and pokes per-client
+velocity arrays through module globals; here every rule is
+
+    (gradient, Vvelocity, Verror, lr) -> (update, Vvelocity', Verror', mask)
+
+with no side effects, so the whole thing jits and differentiates state
+threading explicitly. ``mask`` is the boolean nonzero-support of the update in
+*transmitted* space (dense coords, or sketch-table cells), returned so the
+runtime can apply the reference's momentum-factor-masking to participating
+clients' local velocities (fed_aggregator.py:528-533 — note the reference has
+a latent bug there: ``g_participating_clients`` is assigned without ``global``
+at fed_aggregator.py:220, so its masking never fires; we implement the
+documented intent).
+
+Error-feedback/masking scatters (`Verror[update.nonzero()] = 0`) are expressed
+as ``jnp.where`` with the support mask — branch-free, fusable, no scatters.
+
+Legal (mode x error_type x momentum) combinations follow the reference's
+runtime asserts (fed_worker.py:221-228, fed_aggregator.py:484-486, 512,
+545, 573-576); see ``validate_mode_combo``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.ops import topk
+from commefficient_tpu.ops.sketch import CountSketch, sketch_encode, sketch_unsketch
+
+
+def validate_mode_combo(cfg: FedConfig) -> None:
+    """Reject illegal mode/error/momentum combinations up front.
+
+    The reference lets several illegal combos crash deep inside a worker
+    process (fed_worker.py:221-228) or, worse, silently not train (sketch
+    with error_type=none zero-sketches Verror forever,
+    fed_aggregator.py:578-590); we fail fast with an explanation.
+    """
+    m, e = cfg.mode, cfg.error_type
+    if m == "sketch":
+        if e != "virtual":
+            raise ValueError(
+                "mode=sketch requires error_type=virtual (FetchSGD). "
+                "error_type=none would unsketch an all-zero error table and "
+                "never update; error_type=local allocates client error rows "
+                "that the reference's own worker forbids for sketch "
+                "(fed_worker.py:221-222 — its server-side 'local' branch at "
+                "fed_aggregator.py:579-580 is unreachable dead code), and "
+                "unmasked client error rows grow without bound")
+        if cfg.local_momentum > 0:
+            raise ValueError("mode=sketch cannot use local momentum "
+                             "(reference assert fed_worker.py:227-228)")
+    elif m == "true_topk":
+        if e != "virtual":
+            raise ValueError("mode=true_topk requires error_type=virtual "
+                             "(reference assert fed_aggregator.py:512)")
+    elif m == "local_topk":
+        if e not in ("local", "none"):
+            raise ValueError("mode=local_topk requires error_type local|none "
+                             "(reference assert fed_aggregator.py:545)")
+    elif m == "fedavg":
+        if e != "none" or cfg.local_momentum != 0:
+            raise ValueError("fedavg requires error_type=none and "
+                             "local_momentum=0 (reference utils.py:225-228)")
+    elif m == "uncompressed":
+        if e == "local":
+            raise ValueError("mode=uncompressed cannot use local error "
+                             "(reference assert fed_worker.py:221-222)")
+
+
+def server_update(
+    cfg: FedConfig,
+    gradient: jax.Array,
+    Vvelocity: jax.Array,
+    Verror: jax.Array,
+    lr: jax.Array,
+    cs: Optional[CountSketch] = None,
+    dp_rng: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, Optional[jax.Array]]:
+    """Dispatch to the mode's update rule (reference fed_aggregator.py:469-481).
+
+    ``gradient`` is the aggregated transmitted quantity, already averaged by
+    datum count (reference fed_aggregator.py:332). ``lr`` may be a scalar or a
+    per-parameter vector (Fixup param groups, fed_aggregator.py:411-427).
+    Returns (weight_update, Vvelocity', Verror', support_mask_or_None).
+    """
+    rho = cfg.virtual_momentum
+    if cfg.mode == "fedavg":
+        # reference fed_aggregator.py:483-495: running average of weight
+        # deltas; LR was already applied on the client, so update==Vvelocity.
+        Vvel = gradient + rho * Vvelocity
+        return Vvel, Vvel, Verror, None
+
+    if cfg.mode == "uncompressed":
+        # reference fed_aggregator.py:497-509
+        Vvel = gradient + rho * Vvelocity
+        grad = Vvel
+        if cfg.do_dp and cfg.dp_mode == "server":
+            noise = cfg.noise_multiplier * jax.random.normal(
+                dp_rng, grad.shape, grad.dtype)
+            grad = grad + noise
+        return grad * lr, Vvel, Verror, None
+
+    if cfg.mode == "true_topk":
+        # reference fed_aggregator.py:511-542
+        Vvel = gradient + rho * Vvelocity
+        Verr = Verror + Vvel
+        update = topk(Verr, k=cfg.k)
+        mask = update != 0
+        # error feedback + momentum factor masking at the update support
+        Verr = jnp.where(mask, 0.0, Verr)
+        Vvel = jnp.where(mask, 0.0, Vvel)
+        return update * lr, Vvel, Verr, mask
+
+    if cfg.mode == "local_topk":
+        # reference fed_aggregator.py:544-566: momentum accumulates onto the
+        # already-sparse summed worker top-k; no virtual error, no masking.
+        Vvel = gradient + rho * Vvelocity
+        return Vvel * lr, Vvel, Verror, None
+
+    if cfg.mode == "sketch":
+        # FetchSGD core, reference fed_aggregator.py:568-613. All state lives
+        # in (r, c) sketch-table space; tables are linear so the psum'd
+        # worker tables equal the sketch of the summed gradient.
+        assert cs is not None
+        Vvel = gradient + rho * Vvelocity
+        Verr = Verror + Vvel  # virtual error (the only legal type, see above)
+        update = sketch_unsketch(cs, Verr, k=cfg.k)
+        # re-sketch the dense update to find which table cells it occupies
+        # (reference fed_aggregator.py:593-595)
+        sketched_update = sketch_encode(cs, update)
+        mask = sketched_update != 0
+        Vvel = jnp.where(mask, 0.0, Vvel)
+        Verr = jnp.where(mask, 0.0, Verr)
+        return update * lr, Vvel, Verr, mask
+
+    raise ValueError(f"unknown mode {cfg.mode}")
