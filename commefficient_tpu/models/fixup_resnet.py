@@ -1,0 +1,102 @@
+"""Fixup ImageNet ResNets (BN-free bottleneck ResNet-50).
+
+Parity target: reference CommEfficient/models/fixup_resnet.py:8-10, which
+subclasses the external ``fixup`` package's ImageNet FixupResNet (Zhang et
+al., "Fixup Initialization", ICLR 2019) with Bottleneck blocks [3,4,6,3].
+That package is CUDA/torch; this is a from-scratch Flax implementation of
+the same scheme:
+
+- no normalization layers anywhere;
+- per-block scalar biases before each conv/relu and a scalar multiplier on
+  the residual branch;
+- the residual branch's *last* conv is zero-initialized, earlier convs are
+  He-init scaled by ``L^(-1/(2m-2))`` (m = convs per block, 3 for
+  bottleneck), and the classifier is zero-initialized.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from commefficient_tpu.models.layers import (
+    Scalar,
+    global_avg_pool,
+    max_pool,
+)
+
+
+def _scaled_he(num_layers: int, m: int):
+    he = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+    def init(key, shape, dtype=jnp.float32):
+        return he(key, shape, dtype) * num_layers ** (-1.0 / (2 * m - 2))
+
+    return init
+
+
+class FixupBottleneck(nn.Module):
+    features: int        # planes; output = 4x
+    num_layers: int      # total blocks, for init scaling
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        out_ch = self.features * 4
+        init = _scaled_he(self.num_layers, m=3)
+        b1a, b1b = Scalar(0.0, name="bias1a")(), Scalar(0.0, name="bias1b")()
+        b2a, b2b = Scalar(0.0, name="bias2a")(), Scalar(0.0, name="bias2b")()
+        b3a, b3b = Scalar(0.0, name="bias3a")(), Scalar(0.0, name="bias3b")()
+        scale = Scalar(1.0, name="scale")()
+
+        y = nn.Conv(self.features, (1, 1), padding="VALID", use_bias=False,
+                    kernel_init=init, name="conv1")(x + b1a)
+        y = nn.relu(y + b1b)
+        y = nn.Conv(self.features, (3, 3), strides=(self.stride, self.stride),
+                    padding=1, use_bias=False, kernel_init=init,
+                    name="conv2")(y + b2a)
+        y = nn.relu(y + b2b)
+        y = nn.Conv(out_ch, (1, 1), padding="VALID", use_bias=False,
+                    kernel_init=nn.initializers.zeros, name="conv3")(y + b3a)
+        y = y * scale + b3b
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            sc = nn.Conv(out_ch, (1, 1), strides=(self.stride, self.stride),
+                         padding="VALID", use_bias=False,
+                         name="shortcut")(x + b1a)
+        else:
+            sc = x
+        return nn.relu(y + sc)
+
+
+class FixupResNetImageNet(nn.Module):
+    layers: Sequence[int] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    initial_channels: int = 3
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        depth = sum(self.layers)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=False,
+                    name="stem")(x)
+        bias1 = Scalar(0.0, name="bias1")()
+        x = nn.relu(x + bias1)
+        x = max_pool(x, 3, stride=2, padding=((1, 1), (1, 1)))
+        for stage, (planes, n) in enumerate(zip((64, 128, 256, 512),
+                                                self.layers)):
+            for i in range(n):
+                x = FixupBottleneck(
+                    planes, depth,
+                    stride=2 if stage > 0 and i == 0 else 1,
+                    name=f"stage{stage}_block{i}")(x)
+        x = global_avg_pool(x)
+        bias2 = Scalar(0.0, name="bias2")()
+        return nn.Dense(self.num_classes, kernel_init=nn.initializers.zeros,
+                        name="fc")(x + bias2)
+
+
+def FixupResNet50(num_classes: int = 1000, **kw):
+    return FixupResNetImageNet(layers=(3, 4, 6, 3), num_classes=num_classes,
+                               **kw)

@@ -1,0 +1,199 @@
+"""GPT-2 with LM + multiple-choice heads, Flax from scratch.
+
+Parity target: the reference's external ``GPT2DoubleHeadsModel`` from
+``pytorch_transformers`` (gpt2_train.py:4-6, 262-285): token + learned
+position + token-type embeddings, pre-LN causal transformer, LM head tied to
+the token embedding, and a multiple-choice head that scores each candidate
+from the hidden state at its ``mc_token_id`` (the last token). The reference
+resizes embeddings after adding 5 special tokens
+(``add_special_tokens_``, gpt2_train.py:101-112) — here ``num_added_tokens``
+sizes the table up front and ``load_hf_weights`` pads the pretrained rows.
+
+TPU-native choices: bfloat16 activations with fp32 LayerNorm/softmax
+accumulation; attention is pluggable (``attn_impl``) so the same module runs
+dense single-chip attention or ring attention over a ``seq`` mesh axis
+(parallel/ring.py) for long-context — new scope beyond the reference, which
+has no sequence parallelism (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+NUM_SPECIAL_TOKENS = 5  # <bos> <eos> <speaker1> <speaker2> <pad>
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    num_added_tokens: int = NUM_SPECIAL_TOKENS
+    layer_norm_eps: float = 1e-5
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def total_vocab(self) -> int:
+        return self.vocab_size + self.num_added_tokens
+
+    @classmethod
+    def small(cls, **kw) -> "GPT2Config":
+        """A tiny config for tests/smoke (not a reference size)."""
+        base = dict(vocab_size=256, n_positions=128, n_embd=64, n_layer=2,
+                    n_head=4)
+        base.update(kw)
+        return cls(**base)
+
+
+def dense_causal_attention(q, k, v, dropout_rng=None):
+    """Plain causal attention: q,k,v (..., S, H, D) -> (..., S, H, D).
+    fp32 softmax accumulation regardless of input dtype."""
+    S = q.shape[-3]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, k).astype(jnp.float32)
+    logits = logits * scale
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("...hqk,...khd->...qhd", probs, v)
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+    attn_impl: Callable = dense_causal_attention
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        H, D = cfg.n_head, cfg.n_embd // cfg.n_head
+        dt = cfg.compute_dtype
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="ln_1")(x).astype(dt)
+        qkv = nn.Dense(3 * cfg.n_embd, dtype=dt, name="c_attn")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(t.shape[:-1] + (H, D))
+        a = self.attn_impl(split(q), split(k), split(v))
+        a = a.reshape(a.shape[:-2] + (cfg.n_embd,))
+        x = x + nn.Dense(cfg.n_embd, dtype=dt, name="c_proj")(a)
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="ln_2")(x).astype(dt)
+        h = nn.Dense(4 * cfg.n_embd, dtype=dt, name="c_fc")(h)
+        h = nn.gelu(h, approximate=True)
+        x = x + nn.Dense(cfg.n_embd, dtype=dt, name="mlp_proj")(h)
+        return x
+
+
+class GPT2Backbone(nn.Module):
+    cfg: GPT2Config
+    attn_impl: Callable = dense_causal_attention
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, position_ids=None):
+        cfg = self.cfg
+        S = input_ids.shape[-1]
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.total_vocab, cfg.n_embd))
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.n_positions, cfg.n_embd))
+        if position_ids is None:
+            position_ids = jnp.arange(S)
+        x = wte[input_ids] + wpe[position_ids]
+        if token_type_ids is not None:
+            x = x + wte[token_type_ids]
+        x = x.astype(cfg.compute_dtype)
+        for i in range(cfg.n_layer):
+            x = Block(cfg, self.attn_impl, name=f"h{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="ln_f")(x)
+        return x, wte
+
+
+class GPT2DoubleHeads(nn.Module):
+    """LM + MC heads over the backbone.
+
+    ``input_ids``/``token_type_ids``: (..., S); ``mc_token_ids``: (...,) index
+    of the scoring token per sequence. Returns (lm_logits fp32 (..., S, V),
+    mc_logits fp32 (...,)).
+    """
+
+    cfg: GPT2Config
+    attn_impl: Callable = dense_causal_attention
+
+    @nn.compact
+    def __call__(self, input_ids, mc_token_ids, token_type_ids=None):
+        hidden, wte = GPT2Backbone(self.cfg, self.attn_impl,
+                                   name="transformer")(
+            input_ids, token_type_ids)
+        lm_logits = (hidden @ wte.T.astype(hidden.dtype)).astype(jnp.float32)
+        mc_hidden = jnp.take_along_axis(
+            hidden, mc_token_ids[..., None, None], axis=-2)[..., 0, :]
+        mc_logits = nn.Dense(1, dtype=jnp.float32,
+                             name="mc_head")(mc_hidden)[..., 0]
+        return lm_logits, mc_logits
+
+
+class GPT2LMHead(nn.Module):
+    """Pure LM variant (no MC head) for generic language modeling."""
+
+    cfg: GPT2Config
+    attn_impl: Callable = dense_causal_attention
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None):
+        hidden, wte = GPT2Backbone(self.cfg, self.attn_impl,
+                                   name="transformer")(
+            input_ids, token_type_ids)
+        return (hidden @ wte.T.astype(hidden.dtype)).astype(jnp.float32)
+
+
+def load_hf_weights(params, cfg: GPT2Config, checkpoint: str = "gpt2"):
+    """Fill a ``GPT2DoubleHeads``/``GPT2LMHead`` param pytree from a local
+    HuggingFace torch GPT-2 checkpoint, padding the embedding table for the
+    added special tokens with the mean embedding (the effect of the
+    reference's resize, gpt2_train.py:101-112). Returns the updated pytree,
+    or None when no local checkpoint is available (zero-egress environments
+    fall back to random init)."""
+    try:
+        from transformers import GPT2Model  # noqa: WPS433
+        hf = GPT2Model.from_pretrained(checkpoint, local_files_only=True)
+    except Exception:
+        return None
+    import numpy as np
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+
+    p = jax.tree.map(lambda t: t, params)  # shallow copy
+    tr = p["params"]["transformer"]
+    wte = sd["wte.weight"]
+    pad = np.tile(wte.mean(0, keepdims=True),
+                  (cfg.total_vocab - wte.shape[0], 1))
+    tr["wte"] = jnp.asarray(np.concatenate([wte, pad], 0))
+    tr["wpe"] = jnp.asarray(sd["wpe.weight"][: cfg.n_positions])
+    for i in range(cfg.n_layer):
+        b = tr[f"h{i}"]
+        hfp = f"h.{i}."
+        # HF GPT-2 uses Conv1D: weights already (in, out) — matches Dense
+        b["c_attn"]["kernel"] = jnp.asarray(sd[hfp + "attn.c_attn.weight"])
+        b["c_attn"]["bias"] = jnp.asarray(sd[hfp + "attn.c_attn.bias"])
+        b["c_proj"]["kernel"] = jnp.asarray(sd[hfp + "attn.c_proj.weight"])
+        b["c_proj"]["bias"] = jnp.asarray(sd[hfp + "attn.c_proj.bias"])
+        b["c_fc"]["kernel"] = jnp.asarray(sd[hfp + "mlp.c_fc.weight"])
+        b["c_fc"]["bias"] = jnp.asarray(sd[hfp + "mlp.c_fc.bias"])
+        b["mlp_proj"]["kernel"] = jnp.asarray(sd[hfp + "mlp.c_proj.weight"])
+        b["mlp_proj"]["bias"] = jnp.asarray(sd[hfp + "mlp.c_proj.bias"])
+        b["ln_1"]["scale"] = jnp.asarray(sd[hfp + "ln_1.weight"])
+        b["ln_1"]["bias"] = jnp.asarray(sd[hfp + "ln_1.bias"])
+        b["ln_2"]["scale"] = jnp.asarray(sd[hfp + "ln_2.weight"])
+        b["ln_2"]["bias"] = jnp.asarray(sd[hfp + "ln_2.bias"])
+    tr["ln_f"]["scale"] = jnp.asarray(sd["ln_f.weight"])
+    tr["ln_f"]["bias"] = jnp.asarray(sd["ln_f.bias"])
+    return p

@@ -1,0 +1,131 @@
+"""Shared building blocks for the model zoo.
+
+TPU-first conventions that differ from the reference's torch models
+(CommEfficient/models/*):
+
+- **NHWC layout.** Flax/XLA convolutions are fastest channel-last on TPU;
+  the reference's NCHW is a CUDA/cuDNN artifact.
+- **Stateless BatchNorm.** The reference's ``do_batchnorm`` path keeps
+  running statistics (models/resnet9.py:17-29) which are mutable state a
+  functional, vmapped-per-client federated step cannot thread (and which are
+  exactly what breaks under tiny non-iid client batches — the reason the
+  reference grew its Fixup/LayerNorm variants, models/resnets.py:87-97).
+  ``BatchStatNorm`` normalizes with the *current* batch statistics in both
+  train and eval, which under per-client vmap gives each simulated client
+  its own statistics — the federated-correct semantics.
+- **Scalar Fixup params** (scale/bias) are rank-0 arrays, matching the
+  reference's ``nn.Parameter(torch.zeros(1))`` (models/fixup_resnet18.py:8-22)
+  in effect.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def conv3x3(features: int, stride: int = 1, groups: int = 1,
+            dilation: int = 1, name: Optional[str] = None) -> nn.Conv:
+    return nn.Conv(features, (3, 3), strides=(stride, stride),
+                   padding=dilation, feature_group_count=groups,
+                   kernel_dilation=(dilation, dilation), use_bias=False,
+                   name=name)
+
+
+def conv1x1(features: int, stride: int = 1,
+            name: Optional[str] = None) -> nn.Conv:
+    return nn.Conv(features, (1, 1), strides=(stride, stride),
+                   padding="VALID", use_bias=False, name=name)
+
+
+def max_pool(x: jax.Array, window: int, stride: Optional[int] = None,
+             padding: Any = "VALID") -> jax.Array:
+    stride = stride if stride is not None else window
+    return nn.max_pool(x, (window, window), (stride, stride), padding)
+
+
+def global_avg_pool(x: jax.Array) -> jax.Array:
+    return x.mean(axis=(1, 2))
+
+
+def global_max_pool(x: jax.Array) -> jax.Array:
+    return x.max(axis=(1, 2))
+
+
+class BatchStatNorm(nn.Module):
+    """BatchNorm without running statistics (always batch stats).
+
+    Learned per-channel scale/bias; normalization over (N, H, W). See module
+    docstring for why this replaces the reference's stateful BatchNorm2d.
+    """
+
+    epsilon: float = 1e-5
+    scale_init: Callable = nn.initializers.ones
+    bias_init: Callable = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = x.shape[-1]
+        scale = self.param("scale", self.scale_init, (c,))
+        bias = self.param("bias", self.bias_init, (c,))
+        mean = x.mean(axis=(0, 1, 2), keepdims=True)
+        var = x.var(axis=(0, 1, 2), keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+        return y * scale + bias
+
+
+class SpatialLayerNorm(nn.Module):
+    """LayerNorm over the full (H, W, C) feature map of each example —
+    the semantics of the reference's ``nn.LayerNorm((C, hw, hw))`` with
+    explicit static spatial shapes (models/resnets.py:87-97). Shape-agnostic
+    here because normalized axes are all non-batch axes."""
+
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        shape = x.shape[1:]
+        scale = self.param("scale", nn.initializers.ones, shape)
+        bias = self.param("bias", nn.initializers.zeros, shape)
+        mean = x.mean(axis=(1, 2, 3), keepdims=True)
+        var = x.var(axis=(1, 2, 3), keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+        return y * scale + bias
+
+
+class Scalar(nn.Module):
+    """A single learned scalar, used multiplicatively or additively by the
+    Fixup blocks."""
+
+    init_value: float = 0.0
+
+    @nn.compact
+    def __call__(self) -> jax.Array:
+        return self.param(
+            "value", lambda _key: jnp.asarray(self.init_value, jnp.float32))
+
+
+def make_norm(norm: str) -> Callable[..., nn.Module]:
+    """Norm factory: 'batch' -> BatchStatNorm, 'layer' -> SpatialLayerNorm,
+    'none' -> identity."""
+    if norm == "batch":
+        return BatchStatNorm
+    if norm == "layer":
+        return SpatialLayerNorm
+    if norm == "none":
+        return lambda **kw: (lambda x: x)  # type: ignore[return-value]
+    raise ValueError(f"unknown norm {norm!r}")
+
+
+def fixup_conv_init(num_layers: int) -> Callable:
+    """He-init scaled by L^(-1/2) for the first conv of a Fixup block
+    (reference models/fixup_resnet18.py:88-94)."""
+    he = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+    def init(key, shape, dtype=jnp.float32):
+        return he(key, shape, dtype) * num_layers ** (-0.5)
+
+    return init

@@ -1,0 +1,91 @@
+"""Self-contained CIFAR ResNet-18s: BN and Fixup variants.
+
+Parity targets: reference CommEfficient/models/fixup_resnet18.py:66-216 —
+3x3 prep conv to 64ch, four stages of two blocks each with widths
+(64, 128, 256, 256) and strides (1, 2, 2, 2), a dual global avg+max pooled
+head (concat -> 512 features) and a linear classifier. ``FixupResNet18`` uses
+BN-free Fixup blocks (zero-init classifier, He/L^-0.5 conv1, zero conv2);
+``ResNet18`` uses post-activation conv+BN blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from commefficient_tpu.models.layers import (
+    BatchStatNorm,
+    conv1x1,
+    conv3x3,
+    global_avg_pool,
+    global_max_pool,
+)
+from commefficient_tpu.models.resnet9 import FixupBasicBlock
+
+STAGE_WIDTHS = (64, 128, 256, 256)
+STAGE_STRIDES = (1, 2, 2, 2)
+
+
+class BNBlock(nn.Module):
+    """conv-bn-relu x2 with projection shortcut on shape change
+    (reference ``PreActBlock`` as actually written, fixup_resnet18.py:139-166)."""
+
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        y = conv3x3(self.features, stride=self.stride)(x)
+        y = nn.relu(BatchStatNorm()(y))
+        y = conv3x3(self.features)(y)
+        y = nn.relu(BatchStatNorm()(y))
+        if self.stride != 1 or x.shape[-1] != self.features:
+            x = conv1x1(self.features, stride=self.stride)(x)
+        return y + x
+
+
+class _DualPoolHead(nn.Module):
+    num_classes: int
+    zero_init: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = jnp.concatenate([global_avg_pool(x), global_max_pool(x)], axis=-1)
+        kernel_init = (nn.initializers.zeros if self.zero_init
+                       else nn.initializers.lecun_normal())
+        return nn.Dense(self.num_classes, kernel_init=kernel_init,
+                        name="classifier")(x)
+
+
+class ResNet18(nn.Module):
+    num_classes: int = 10
+    num_blocks: Sequence[int] = (2, 2, 2, 2)
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.relu(conv3x3(64, name="prep")(x))
+        for stage, (w, s, n) in enumerate(
+                zip(STAGE_WIDTHS, STAGE_STRIDES, self.num_blocks)):
+            for i in range(n):
+                x = BNBlock(w, stride=s if i == 0 else 1,
+                            name=f"stage{stage}_block{i}")(x)
+        return _DualPoolHead(self.num_classes)(x)
+
+
+class FixupResNet18(nn.Module):
+    num_classes: int = 10
+    num_blocks: Sequence[int] = (2, 2, 2, 2)
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        depth = sum(self.num_blocks)
+        x = nn.relu(conv3x3(64, name="prep")(x))
+        for stage, (w, s, n) in enumerate(
+                zip(STAGE_WIDTHS, STAGE_STRIDES, self.num_blocks)):
+            for i in range(n):
+                x = FixupBasicBlock(w, depth, stride=s if i == 0 else 1,
+                                    name=f"stage{stage}_block{i}")(x)
+        return _DualPoolHead(self.num_classes, zero_init=True)(x)

@@ -1,0 +1,171 @@
+"""ResNet-9 (cifar10_fast lineage) and its Fixup variant.
+
+Behavioral parity targets:
+- ``ResNet9``: reference CommEfficient/models/resnet9.py:132-148 (net at
+  74-130) — prep 3x3 conv to 64ch, three ConvBN stages (128/256/512) with
+  2x max-pool, residual pairs after stages 1 and 3, final 4x max-pool,
+  bias-free linear head scaled by ``weight=0.125`` (the ``Mul`` classifier),
+  optional batch norm via ``do_batchnorm``, and a finetune mode that swaps
+  the head for ``new_num_classes`` and trains only head params
+  (reference ``finetune_parameters``, models/resnet9.py:105-113).
+- ``FixupResNet9``: reference models/fixup_resnet9.py:10-91 — the BN-free
+  version built from Fixup-initialized layers with scalar scale/bias params.
+
+TPU-native deviations: NHWC layout; stateless batch-stat normalization (see
+models/layers.py docstring).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from commefficient_tpu.models.layers import (
+    BatchStatNorm,
+    Scalar,
+    conv3x3,
+    fixup_conv_init,
+    max_pool,
+)
+
+DEFAULT_CHANNELS = {"prep": 64, "layer1": 128, "layer2": 256, "layer3": 512}
+
+
+class ConvBN(nn.Module):
+    """3x3 conv (+ optional norm) + ReLU (+ optional 2x pool)."""
+
+    features: int
+    do_batchnorm: bool = False
+    pool: int = 0  # 0 = no pool, else pool window
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = conv3x3(self.features)(x)
+        if self.do_batchnorm:
+            x = BatchStatNorm()(x)
+        x = nn.relu(x)
+        if self.pool:
+            x = max_pool(x, self.pool)
+        return x
+
+
+class Residual(nn.Module):
+    """x + relu(conv2(conv1(x))) with each conv a ConvBN
+    (reference models/resnet9.py:61-68: ``x + F.relu(res2(res1(x)))``)."""
+
+    features: int
+    do_batchnorm: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        y = ConvBN(self.features, self.do_batchnorm)(x)
+        y = ConvBN(self.features, self.do_batchnorm)(y)
+        return x + nn.relu(y)
+
+
+class ResNet9(nn.Module):
+    do_batchnorm: bool = False
+    num_classes: int = 10
+    initial_channels: int = 3
+    channels: Optional[Dict[str, int]] = None
+    weight: float = 0.125
+    pool: int = 2
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        ch = self.channels or DEFAULT_CHANNELS
+        bn = self.do_batchnorm
+        x = ConvBN(ch["prep"], bn)(x)
+        x = ConvBN(ch["layer1"], bn, pool=self.pool)(x)
+        x = Residual(ch["layer1"], bn)(x)
+        x = ConvBN(ch["layer2"], bn, pool=self.pool)(x)
+        x = ConvBN(ch["layer3"], bn, pool=self.pool)(x)
+        x = Residual(ch["layer3"], bn)(x)
+        # reference uses MaxPool2d(4) (models/resnet9.py:92), which on the
+        # 4x4 CIFAR feature map IS global max pooling; the global form also
+        # handles other input sizes (e.g. 28x28 EMNIST -> 3x3 here)
+        x = x.max(axis=(1, 2))
+        x = nn.Dense(self.num_classes, use_bias=False, name="head")(x)
+        return x * self.weight
+
+
+class FixupLayer(nn.Module):
+    """conv(x + bias1a)*scale + bias1b, relu, pool, then ``num_blocks``
+    Fixup basic blocks (reference models/fixup_resnet9.py:10-31)."""
+
+    features: int
+    num_blocks: int
+    pool: int = 2
+    num_layers: int = 2  # total fixup depth, for init scaling
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b1a = Scalar(0.0, name="bias1a")()
+        b1b = Scalar(0.0, name="bias1b")()
+        scale = Scalar(1.0, name="scale")()
+        x = conv3x3(self.features)(x + b1a) * scale + b1b
+        x = nn.relu(x)
+        if self.pool:
+            x = max_pool(x, self.pool)
+        for i in range(self.num_blocks):
+            x = FixupBasicBlock(self.features, self.num_layers,
+                                name=f"block{i}")(x)
+        return x
+
+
+class FixupBasicBlock(nn.Module):
+    """Two-conv Fixup residual block: conv1 He/L^-0.5 init, conv2 zero init,
+    scalar biases around each conv and a scalar scale before the residual add
+    (the arrangement of reference models/fixup_resnet18.py:24-64)."""
+
+    features: int
+    num_layers: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b1a = Scalar(0.0, name="bias1a")()
+        b1b = Scalar(0.0, name="bias1b")()
+        b2a = Scalar(0.0, name="bias2a")()
+        b2b = Scalar(0.0, name="bias2b")()
+        scale = Scalar(1.0, name="scale")()
+        y = nn.Conv(self.features, (3, 3), strides=(self.stride, self.stride),
+                    padding=1, use_bias=False,
+                    kernel_init=fixup_conv_init(self.num_layers),
+                    name="conv1")(x + b1a)
+        y = nn.relu(y + b1b)
+        y = nn.Conv(self.features, (3, 3), padding=1, use_bias=False,
+                    kernel_init=nn.initializers.zeros, name="conv2")(y + b2a)
+        y = y * scale + b2b
+        if self.stride != 1 or x.shape[-1] != self.features:
+            sc = nn.Conv(self.features, (1, 1),
+                         strides=(self.stride, self.stride), padding="VALID",
+                         use_bias=False, name="shortcut")(x)
+        else:
+            sc = x
+        return nn.relu(y + sc)
+
+
+class FixupResNet9(nn.Module):
+    num_classes: int = 10
+    channels: Optional[Dict[str, int]] = None
+    pool: int = 2
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        ch = self.channels or DEFAULT_CHANNELS
+        b1a = Scalar(0.0, name="bias1a")()
+        b1b = Scalar(0.0, name="bias1b")()
+        scale = Scalar(1.0, name="scale")()
+        x = conv3x3(ch["prep"])(x + b1a) * scale + b1b
+        x = nn.relu(x)
+        x = FixupLayer(ch["layer1"], 1, pool=self.pool, name="layer1")(x)
+        x = FixupLayer(ch["layer2"], 0, pool=self.pool, name="layer2")(x)
+        x = FixupLayer(ch["layer3"], 1, pool=self.pool, name="layer3")(x)
+        x = x.max(axis=(1, 2))  # global max pool (see ResNet9)
+        b2 = Scalar(0.0, name="bias2")()
+        x = nn.Dense(self.num_classes, name="head")(x + b2)
+        return x

@@ -1,0 +1,131 @@
+"""torchvision-style ResNet family with a LayerNorm option.
+
+Parity target: reference CommEfficient/models/resnets.py:133-370, whose two
+deliberate modifications from stock torchvision are (a) the stem conv takes
+**1 input channel** (EMNIST, resnets.py:155) and (b) every norm site can be
+``nn.LayerNorm`` with explicit spatial shapes instead of BatchNorm
+(resnets.py:87-97, 157-160, 199-204) — BN-free variants matter because
+BatchNorm breaks under tiny non-iid federated client batches. Our
+``SpatialLayerNorm`` infers the spatial shape from the traced activation, so
+no hand-threaded ``hw`` bookkeeping is needed.
+
+Constructors mirror the reference's exported names
+(``resnet18`` … ``wide_resnet101_2``, models/__init__.py:1-7) plus
+``ResNet101LN`` (models/resnet101ln.py:7-13: resnet101 + LayerNorm,
+62 classes for FEMNIST).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+import jax
+from flax import linen as nn
+
+from commefficient_tpu.models.layers import (
+    conv1x1,
+    conv3x3,
+    global_avg_pool,
+    make_norm,
+    max_pool,
+)
+
+
+class BasicBlock(nn.Module):
+    features: int
+    stride: int = 1
+    norm: str = "batch"
+    groups: int = 1
+    base_width: int = 64
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        Norm = make_norm(self.norm)
+        y = conv3x3(self.features, stride=self.stride)(x)
+        y = nn.relu(Norm()(y))
+        y = conv3x3(self.features)(y)
+        y = Norm()(y)
+        if self.stride != 1 or x.shape[-1] != self.features:
+            x = Norm()(conv1x1(self.features, stride=self.stride,
+                               name="downsample_conv")(x))
+        return nn.relu(y + x)
+
+
+class Bottleneck(nn.Module):
+    features: int           # "planes"; output width is features * 4
+    stride: int = 1
+    norm: str = "batch"
+    groups: int = 1
+    base_width: int = 64
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        Norm = make_norm(self.norm)
+        width = int(self.features * (self.base_width / 64.0)) * self.groups
+        out_ch = self.features * self.expansion
+        y = nn.relu(Norm()(conv1x1(width)(x)))
+        y = nn.relu(Norm()(conv3x3(width, stride=self.stride,
+                                   groups=self.groups)(y)))
+        y = Norm()(conv1x1(out_ch)(y))
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            x = Norm()(conv1x1(out_ch, stride=self.stride,
+                               name="downsample_conv")(x))
+        return nn.relu(y + x)
+
+
+class ResNet(nn.Module):
+    block: Callable[..., nn.Module]
+    layers: Sequence[int]
+    num_classes: int = 1000
+    norm: str = "batch"
+    groups: int = 1
+    width_per_group: int = 64
+    initial_channels: int = 1  # reference hardcodes 1 (EMNIST), resnets.py:155
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        Norm = make_norm(self.norm)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=False,
+                    name="stem")(x)
+        x = nn.relu(Norm()(x))
+        x = max_pool(x, 3, stride=2, padding=((1, 1), (1, 1)))
+        for stage, (planes, n) in enumerate(zip((64, 128, 256, 512),
+                                                self.layers)):
+            for i in range(n):
+                x = self.block(planes, stride=(2 if stage > 0 and i == 0
+                                               else 1),
+                               norm=self.norm, groups=self.groups,
+                               base_width=self.width_per_group,
+                               name=f"stage{stage}_block{i}")(x)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, name="fc")(x)
+
+
+def _make(block, layers, **fixed):
+    def ctor(num_classes: int = 1000, norm: str = "batch",
+             initial_channels: int = 1, **kw):
+        return ResNet(block=block, layers=layers, num_classes=num_classes,
+                      norm=norm, initial_channels=initial_channels,
+                      **{**fixed, **kw})
+    return ctor
+
+
+resnet18 = _make(BasicBlock, (2, 2, 2, 2))
+resnet34 = _make(BasicBlock, (3, 4, 6, 3))
+resnet50 = _make(Bottleneck, (3, 4, 6, 3))
+resnet101 = _make(Bottleneck, (3, 4, 23, 3))
+resnet152 = _make(Bottleneck, (3, 8, 36, 3))
+resnext50_32x4d = _make(Bottleneck, (3, 4, 6, 3), groups=32, width_per_group=4)
+resnext101_32x8d = _make(Bottleneck, (3, 4, 23, 3), groups=32,
+                         width_per_group=8)
+wide_resnet50_2 = _make(Bottleneck, (3, 4, 6, 3), width_per_group=128)
+wide_resnet101_2 = _make(Bottleneck, (3, 4, 23, 3), width_per_group=128)
+
+
+def ResNet101LN(num_classes: int = 62, **kw):
+    """resnet101 with LayerNorm everywhere, 62 classes (FEMNIST) —
+    reference models/resnet101ln.py:7-13."""
+    return resnet101(num_classes=num_classes, norm="layer", **kw)

@@ -1,0 +1,21 @@
+from commefficient_tpu.utils.schedules import PiecewiseLinear, Exp, lr_schedule_for
+from commefficient_tpu.utils.logging import (
+    Logger,
+    TableLogger,
+    TSVLogger,
+    Timer,
+    make_logdir,
+)
+from commefficient_tpu.utils.misc import steps_per_epoch
+
+__all__ = [
+    "PiecewiseLinear",
+    "Exp",
+    "lr_schedule_for",
+    "Logger",
+    "TableLogger",
+    "TSVLogger",
+    "Timer",
+    "make_logdir",
+    "steps_per_epoch",
+]

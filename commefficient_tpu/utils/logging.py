@@ -1,0 +1,90 @@
+"""Console/TSV loggers, wall-clock timer and run-directory naming.
+
+Reference equivalents: ``Logger``/``TableLogger``/``TSVLogger``/``Timer``/
+``make_logdir`` (CommEfficient/utils.py:14-99). Behavior preserved: the table
+logger locks its column set on first append and prints fixed-width rows; the
+TSV logger records ``epoch,hours,top1Accuracy``; ``make_logdir`` encodes the
+run config into a timestamped directory under ``runs/``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from datetime import datetime
+from typing import Dict, Iterable, Optional
+
+
+class Logger:
+    """print-passthrough logger with the stdlib logging method names."""
+
+    def _emit(self, msg, args=None):
+        print(msg.format(args) if args is not None else msg)
+
+    debug = info = warn = warning = error = critical = _emit
+
+
+class TableLogger:
+    """Fixed-width console table; columns fixed by the first row appended."""
+
+    def __init__(self):
+        self.keys: Optional[Iterable[str]] = None
+
+    def append(self, output: Dict):
+        if self.keys is None:
+            self.keys = list(output.keys())
+            print(*(f"{k:>12s}" for k in self.keys))
+        row = []
+        for k in self.keys:
+            v = output[k]
+            if isinstance(v, float):
+                row.append(f"{v:12.4f}")
+            else:
+                row.append(f"{v!s:>12}")
+        print(*row)
+
+
+class TSVLogger:
+    """Time-to-accuracy record: ``epoch,hours,top1Accuracy`` lines."""
+
+    def __init__(self):
+        self.log = ["epoch,hours,top1Accuracy"]
+
+    def append(self, output: Dict):
+        self.log.append("{},{:.8f},{:.2f}".format(
+            output["epoch"], output["total_time"] / 3600,
+            output["test_acc"] * 100))
+
+    def __str__(self):
+        return "\n".join(self.log)
+
+
+class Timer:
+    """Split timer: each call returns the delta since the previous call and
+    (optionally) accumulates it into ``total_time``."""
+
+    def __init__(self):
+        self.times = [time.time()]
+        self.total_time = 0.0
+
+    def __call__(self, include_in_total: bool = True) -> float:
+        self.times.append(time.time())
+        delta = self.times[-1] - self.times[-2]
+        if include_in_total:
+            self.total_time += delta
+        return delta
+
+
+def make_logdir(cfg) -> str:
+    """``runs/<timestamp>_<workers/clients>_<mode[...]>_[k...]`` — same
+    config-encoding scheme as reference utils.py:51-64."""
+    if cfg.mode == "sketch":
+        sketch_str = f"{cfg.mode}: {cfg.num_rows} x {cfg.num_cols}"
+    else:
+        sketch_str = cfg.mode
+    k_str = f"k: {cfg.k}" if cfg.mode in ("sketch", "true_topk",
+                                          "local_topk") else ""
+    clients = cfg.num_clients if cfg.num_clients is not None else "auto"
+    stamp = datetime.now().strftime("%b%d_%H-%M-%S")
+    return os.path.join(
+        "runs", f"{stamp}_{cfg.num_workers}/{clients}_{sketch_str}_{k_str}")

@@ -1,0 +1,17 @@
+"""Small run-math helpers."""
+
+from __future__ import annotations
+
+import math
+
+
+def steps_per_epoch(local_batch_size: int, dataset_len: int,
+                    num_clients: int, num_workers: int) -> int:
+    """Rounds per epoch (reference utils.py:315-321): with whole-client
+    batches (``local_batch_size == -1``) an epoch is one pass over all
+    clients, ``num_workers`` of them per round; otherwise it is the number of
+    rounds needed to see every datum once at ``local_batch_size`` items per
+    participating client."""
+    if local_batch_size == -1:
+        return num_clients // num_workers
+    return math.ceil(dataset_len / (local_batch_size * num_workers))

@@ -1,0 +1,67 @@
+"""Learning-rate schedules.
+
+Reference equivalents: ``PiecewiseLinear`` and ``Exp`` in
+CommEfficient/utils.py:26-35, driven through ``LambdaLR`` by the drivers
+(cv_train.py:394-404, gpt2_train.py:302-307). Here a schedule is simply a
+callable ``epoch_float -> lr``; drivers evaluate it per round and pass the
+scalar into the jitted step, so the schedule itself never needs to trace.
+
+Both schedules are also expressible as pure-jnp functions of a traced step
+(``as_jax``) for fully on-device training loops (``lax.scan`` over rounds).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class PiecewiseLinear:
+    """Linear interpolation through (knot, value) pairs; clamps outside."""
+
+    knots: Sequence[float]
+    vals: Sequence[float]
+
+    def __call__(self, t: float) -> float:
+        return float(np.interp(t, self.knots, self.vals))
+
+    def as_jax(self, t):
+        return jnp.interp(t, jnp.asarray(self.knots, jnp.float32),
+                          jnp.asarray(self.vals, jnp.float32))
+
+
+@dataclasses.dataclass(frozen=True)
+class Exp:
+    """Linear warmup to ``amplitude`` then base-10 exponential decay with
+    scale ``decay_len`` epochs."""
+
+    warmup_epochs: float
+    amplitude: float
+    decay_len: float
+
+    def __call__(self, t: float) -> float:
+        if t < self.warmup_epochs:
+            return float(np.interp(t, [0.0, self.warmup_epochs],
+                                   [0.0, self.amplitude]))
+        return float(self.amplitude
+                     * 10.0 ** (-(t - self.warmup_epochs) / self.decay_len))
+
+    def as_jax(self, t):
+        warm = jnp.interp(t, jnp.asarray([0.0, self.warmup_epochs]),
+                          jnp.asarray([0.0, self.amplitude]))
+        decay = self.amplitude * 10.0 ** (-(t - self.warmup_epochs)
+                                          / self.decay_len)
+        return jnp.where(t < self.warmup_epochs, warm, decay)
+
+
+def lr_schedule_for(cfg) -> PiecewiseLinear:
+    """The drivers' default triangular schedule (reference cv_train.py:393-404):
+    0 -> lr_scale at pivot_epoch -> 0 at num_epochs. The reference notes the
+    cifar10_fast heritage uses knots [0, 5, 24] with vals [0, 0.4, 0]."""
+    lr = cfg.lr_scale if cfg.lr_scale is not None else 0.4
+    return PiecewiseLinear([0.0, cfg.pivot_epoch, float(cfg.num_epochs)],
+                          [0.0, lr, 0.0])
