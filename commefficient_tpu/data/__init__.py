@@ -1,0 +1,45 @@
+"""Federated data layer: client-partitioned datasets + round samplers.
+
+Registry mirrors the reference's ``globals()["Fed" + name]`` lookup
+(cv_train.py:262, gpt2_train.py:316).
+"""
+
+from commefficient_tpu.data.fed_dataset import FedDataset
+from commefficient_tpu.data.fed_sampler import FedSampler, ValSampler, Round
+from commefficient_tpu.data.fed_cifar import FedCIFAR10, FedCIFAR100
+from commefficient_tpu.data.fed_emnist import FedEMNIST
+from commefficient_tpu.data.fed_imagenet import FedImageNet
+from commefficient_tpu.data.fed_persona import FedPERSONA, persona_collate
+from commefficient_tpu.data.transforms import transforms_for
+
+_REGISTRY = {
+    "CIFAR10": FedCIFAR10,
+    "CIFAR100": FedCIFAR100,
+    "EMNIST": FedEMNIST,
+    "ImageNet": FedImageNet,
+    "PERSONA": FedPERSONA,
+}
+
+
+def get_dataset(name: str):
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown dataset {name!r}; "
+                         f"choices: {sorted(_REGISTRY)}") from None
+
+
+__all__ = [
+    "FedDataset",
+    "FedSampler",
+    "ValSampler",
+    "Round",
+    "FedCIFAR10",
+    "FedCIFAR100",
+    "FedEMNIST",
+    "FedImageNet",
+    "FedPERSONA",
+    "persona_collate",
+    "transforms_for",
+    "get_dataset",
+]

@@ -1,0 +1,135 @@
+"""Federated CIFAR-10/100: one natural client per class.
+
+Parity target: reference ``FedCIFAR10``/``FedCIFAR100``
+(CommEfficient/data_utils/fed_cifar.py:13-100): ``prepare_datasets`` splits
+the train set by label into per-client ``client{i}.npy`` files plus a
+``test.npz`` and ``stats.json``; the train *target* of every item equals its
+natural client id (class). We keep the identical on-disk layout (a dataset
+prepared by the reference loads here unchanged) but read it into flat packed
+arrays once.
+
+Source material: the reference uses torchvision's downloader; this
+environment has no torchvision and no network, so ``prepare_datasets``
+consumes the standard CIFAR python pickle directories
+(``cifar-10-batches-py`` / ``cifar-100-python``) if present in
+``dataset_dir``, and otherwise (``synthetic=True``) generates a small
+deterministic class-structured synthetic set so every pipeline stage stays
+exercisable end-to-end.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional
+
+import numpy as np
+
+from commefficient_tpu.data.fed_dataset import FedDataset
+
+
+def _synthetic_cifar(num_classes: int, per_class: int, img_hw: int = 32,
+                     seed: int = 1234):
+    """Class-structured gaussian images: each class has a distinct mean
+    pattern so that models can actually fit the data in tests."""
+    rng = np.random.RandomState(seed)
+    protos = rng.randint(0, 255, size=(num_classes, img_hw, img_hw, 3))
+    images, targets = [], []
+    for c in range(num_classes):
+        noise = rng.randint(-60, 60, size=(per_class, img_hw, img_hw, 3))
+        imgs = np.clip(protos[c][None] + noise, 0, 255).astype(np.uint8)
+        images.append(imgs)
+        targets.append(np.full(per_class, c, dtype=np.int64))
+    return np.concatenate(images), np.concatenate(targets)
+
+
+class FedCIFAR10(FedDataset):
+    num_classes = 10
+    _pickle_dir = "cifar-10-batches-py"
+    _train_files = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_file = "test_batch"
+    _label_key = b"labels"
+
+    def __init__(self, *args, synthetic: Optional[bool] = None,
+                 synthetic_per_class: int = 64, **kw):
+        # synthetic: True = force synthetic, False = require real data,
+        # None = auto-fallback to synthetic (with a warning) when the raw
+        # data is absent — the expected no-network verification path.
+        self._synthetic = synthetic
+        self._synthetic_per_class = synthetic_per_class
+        super().__init__(*args, **kw)
+
+    # --------------------------------------------------------- preparation
+
+    def _load_pickles(self, files):
+        images, labels = [], []
+        for fn in files:
+            with open(os.path.join(self.dataset_dir, self._pickle_dir, fn),
+                      "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            images.append(d[b"data"].reshape(-1, 3, 32, 32)
+                          .transpose(0, 2, 3, 1))  # -> NHWC
+            labels.append(np.asarray(d[self._label_key], dtype=np.int64))
+        return np.concatenate(images), np.concatenate(labels)
+
+    def prepare_datasets(self, download: bool = False) -> None:
+        pickled = os.path.join(self.dataset_dir, self._pickle_dir)
+        if os.path.isdir(pickled) and not self._synthetic:
+            train_images, train_targets = self._load_pickles(
+                self._train_files)
+            test_images, test_targets = self._load_pickles([self._test_file])
+        elif self._synthetic is False:
+            raise FileNotFoundError(
+                f"no {self._pickle_dir} under {self.dataset_dir} and "
+                "synthetic=False; place the CIFAR python pickles there or "
+                "pass synthetic=True")
+        else:
+            if self._synthetic is None:
+                print(f"WARNING: no {self._pickle_dir} under "
+                      f"{self.dataset_dir}; generating synthetic data")
+            train_images, train_targets = _synthetic_cifar(
+                self.num_classes, self._synthetic_per_class)
+            test_images, test_targets = _synthetic_cifar(
+                self.num_classes, max(self._synthetic_per_class // 4, 2),
+                seed=4321)
+
+        os.makedirs(self.dataset_dir, exist_ok=True)
+        images_per_client = []
+        for c in range(self.num_classes):
+            sel = np.where(train_targets == c)[0]
+            images_per_client.append(len(sel))
+            np.save(self.client_fn(c), train_images[sel])
+        np.savez(self.test_fn(), test_images=test_images,
+                 test_targets=test_targets)
+        self.write_stats(self.dataset_dir, images_per_client,
+                         len(test_targets))
+
+    # ------------------------------------------------------------- loading
+
+    def _load_arrays(self) -> None:
+        if self.train:
+            imgs = [np.load(self.client_fn(c))
+                    for c in range(len(self.images_per_client))]
+            images = np.concatenate(imgs)
+            # train target == natural client id (reference fed_cifar.py:78-84)
+            targets = np.repeat(np.arange(len(imgs), dtype=np.int64),
+                                self.images_per_client)
+        else:
+            with np.load(self.test_fn()) as t:
+                images = t["test_images"]
+                targets = t["test_targets"].astype(np.int64)
+        self.arrays = {"image": images, "target": targets}
+
+    def client_fn(self, client_id: int) -> str:
+        return os.path.join(self.dataset_dir, f"client{client_id}.npy")
+
+    def test_fn(self) -> str:
+        return os.path.join(self.dataset_dir, "test.npz")
+
+
+class FedCIFAR100(FedCIFAR10):
+    num_classes = 100
+    _pickle_dir = "cifar-100-python"
+    _train_files = ["train"]
+    _test_file = "test"
+    _label_key = b"fine_labels"

@@ -1,0 +1,151 @@
+"""Client-partitioned in-memory dataset base.
+
+Re-design of the reference's ``FedDataset`` (CommEfficient/data_utils/
+fed_dataset.py:9-99). The reference is a torch ``Dataset`` that maps a flat
+index to (client_id, item) on every ``__getitem__`` via cumsum/searchsorted,
+and feeds a ``DataLoader`` whose worker processes re-do that math per item.
+A TPU input pipeline wants whole static-shape *rounds*, so the base class
+here is an array store:
+
+- training data lives as flat numpy arrays sorted by client, described by
+  ``images_per_client`` (the natural partition);
+- ``data_per_client`` re-partitions for iid mode (global permutation split
+  evenly — reference fed_dataset.py:30-39) or for splitting each natural
+  client/class across ``num_clients // num_natural`` synthetic clients
+  (reference fed_dataset.py:41-48);
+- ``gather(flat_idx)`` materializes any index array into batch arrays in one
+  vectorized fancy-index, so a whole round is built host-side in one call.
+
+Subclasses provide ``prepare_datasets`` (one-time on-disk conversion, same
+protocol as the reference: per-client files + ``stats.json``) and the raw
+array loading.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+class FedDataset:
+    def __init__(self, dataset_dir: str, train: bool = True,
+                 do_iid: bool = False, num_clients: Optional[int] = None,
+                 transform=None, download: bool = False, seed: int = 0):
+        if not do_iid and num_clients == 1:
+            raise ValueError("can't have 1 client when non-iid")
+        self.dataset_dir = dataset_dir
+        self.train = train
+        self.do_iid = do_iid
+        self._num_clients = num_clients
+        self.transform = transform
+
+        if not os.path.exists(self.stats_fn()):
+            self.prepare_datasets(download=download)
+        self._load_meta()
+        self._load_arrays()
+
+        if do_iid:
+            # iid = a fixed global permutation re-dealt evenly to clients
+            # (reference fed_dataset.py:27-28, 64-68)
+            self.iid_shuffle = np.random.RandomState(seed).permutation(
+                len(self))
+
+    # ---------------------------------------------------------------- meta
+
+    def stats_fn(self) -> str:
+        return os.path.join(self.dataset_dir, "stats.json")
+
+    def _load_meta(self) -> None:
+        with open(self.stats_fn()) as f:
+            stats = json.load(f)
+        self.images_per_client = np.array(stats["images_per_client"],
+                                          dtype=np.int64)
+        self.num_val_images = int(stats["num_val_images"])
+
+    @property
+    def num_clients(self) -> int:
+        return (self._num_clients if self._num_clients is not None
+                else len(self.images_per_client))
+
+    @property
+    def data_per_client(self) -> np.ndarray:
+        """Per-synthetic-client datum counts (reference fed_dataset.py:29-48)."""
+        if self.do_iid:
+            n = len(self)
+            per = np.full(self.num_clients, n // self.num_clients,
+                          dtype=np.int64)
+            per[self.num_clients - n % self.num_clients:] += 1
+            return per
+        if self._num_clients is None:
+            return self.images_per_client
+        natural = len(self.images_per_client)
+        if self.num_clients % natural != 0:
+            # the resharding scheme splits every natural client (class)
+            # across num_clients / natural synthetic clients; anything else
+            # would silently produce a different client count than
+            # requested (latent in reference fed_dataset.py:41-48)
+            raise ValueError(
+                f"non-iid num_clients ({self.num_clients}) must be a "
+                f"multiple of the natural client count ({natural}); "
+                "use --iid for arbitrary client counts")
+        out = []
+        shards = self.num_clients // natural
+        for num_images in self.images_per_client:
+            counts = [num_images // shards] * shards
+            counts[-1] += num_images % shards
+            out.extend(counts)
+        return np.array(out, dtype=np.int64)
+
+    def __len__(self) -> int:
+        if self.train:
+            return int(self.images_per_client.sum())
+        return self.num_val_images
+
+    # -------------------------------------------------------------- arrays
+
+    def _load_arrays(self) -> None:
+        """Populate ``self.arrays``: dict of numpy arrays with a common
+        leading flat-index axis (train: sorted by natural client)."""
+        raise NotImplementedError
+
+    def prepare_datasets(self, download: bool = False) -> None:
+        raise NotImplementedError
+
+    def gather(self, flat_idx: np.ndarray) -> Dict[str, np.ndarray]:
+        """Fancy-index every array; under iid the flat index is routed
+        through the global permutation first (reference fed_dataset.py:64-68).
+        Accepts any index shape; output leaves have that leading shape."""
+        idx = np.asarray(flat_idx)
+        if self.train and self.do_iid:
+            idx = self.iid_shuffle[idx]
+        # fused native gather+augment for the image leaf when the C++
+        # data-plane is available (data/native.py)
+        fused_image = None
+        if (self.transform is not None
+                and hasattr(self.transform, "gather_fused")
+                and "image" in self.arrays):
+            fused_image = self.transform.gather_fused(
+                self.arrays["image"], idx)
+        if fused_image is not None:
+            out = {k: v[idx] for k, v in self.arrays.items()
+                   if k != "image"}
+            out["image"] = fused_image
+            return out
+        out = {k: v[idx] for k, v in self.arrays.items()}
+        if self.transform is not None:
+            out = self.transform(out)
+        return out
+
+    # ------------------------------------------------------------- helpers
+
+    @staticmethod
+    def write_stats(dataset_dir: str, images_per_client, num_val_images: int,
+                    **extra) -> None:
+        os.makedirs(dataset_dir, exist_ok=True)
+        stats = {"images_per_client": [int(x) for x in images_per_client],
+                 "num_val_images": int(num_val_images), **extra}
+        with open(os.path.join(dataset_dir, "stats.json"), "w") as f:
+            json.dump(stats, f)

@@ -1,0 +1,226 @@
+"""Federated PersonaChat: clients are distinct personalities.
+
+Parity target: reference ``FedPERSONA`` (CommEfficient/data_utils/
+fed_persona.py:31-392): 17,568 natural clients (one per personality), items
+are next-utterance-classification instances — ``num_candidates`` candidate
+replies (gold last), each encoded as persona ⊕ dialogue history ⊕ reply with
+``<speaker1>/<speaker2>`` segment tokens; model inputs are the 5-tuple
+``input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids``
+(fed_persona.py:27-28) padded per batch (``personachat_collate_fn``,
+360-392).
+
+TPU-native re-design: tokenization happens ONCE in ``prepare_datasets``
+(the reference re-reads and re-tokenizes per-client json on every
+``__getitem__``, fed_persona.py:218-221 — a noted bottleneck); items are
+packed into flat int32 arrays padded to a *static* ``max_seq_len``, so each
+round is one fancy-index gather.
+
+Offline tokenizer: a real GPT-2 BPE is used when its vocab files are on
+disk; otherwise ``HashTokenizer`` (stable word-hash buckets) keeps the whole
+pipeline runnable in zero-egress environments. Synthetic dialogue generation
+stands in for the S3 download (fed_persona.py:23) the environment forbids.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from commefficient_tpu.data.fed_dataset import FedDataset
+
+SPECIAL_TOKENS = ["<bos>", "<eos>", "<speaker1>", "<speaker2>", "<pad>"]
+LM_IGNORE = -100
+
+
+class HashTokenizer:
+    """Deterministic word-level hash tokenizer (offline fallback).
+
+    Stable across processes (crc32, not python ``hash``); special tokens
+    occupy the top ids like the reference's resized GPT-2 table."""
+
+    def __init__(self, base_vocab: int = 8192):
+        self.base_vocab = base_vocab
+        self.special = {t: base_vocab + i for i, t in
+                        enumerate(SPECIAL_TOKENS)}
+
+    def __len__(self):
+        return self.base_vocab + len(SPECIAL_TOKENS)
+
+    def encode(self, text: str) -> List[int]:
+        return [zlib.crc32(w.lower().encode()) % self.base_vocab
+                for w in text.split()]
+
+    def convert_tokens_to_ids(self, tokens):
+        if isinstance(tokens, str):
+            return self.special[tokens]
+        return [self.special[t] for t in tokens]
+
+
+def get_tokenizer(model_checkpoint: str = "gpt2"):
+    """GPT-2 BPE when available locally, HashTokenizer otherwise."""
+    try:
+        from transformers import GPT2Tokenizer
+        tok = GPT2Tokenizer.from_pretrained(model_checkpoint,
+                                            local_files_only=True)
+        tok.add_special_tokens({
+            "bos_token": "<bos>", "eos_token": "<eos>",
+            "pad_token": "<pad>",
+            "additional_special_tokens": ["<speaker1>", "<speaker2>"]})
+        return tok
+    except Exception:
+        return HashTokenizer()
+
+
+def build_input_from_segments(persona: Sequence[List[int]],
+                              history: Sequence[List[int]],
+                              reply: List[int], tokenizer,
+                              lm_labels: bool = False) -> Dict:
+    """Assemble one candidate sequence (reference fed_persona.py:330-358):
+    ``<bos> persona <speaker2/1 alternating> history ... <speaker2> reply
+    <eos>``; token types mark each segment with its speaker token; LM labels
+    cover only the gold reply (+ <eos>)."""
+    bos, eos, spk1, spk2 = [
+        tokenizer.convert_tokens_to_ids(t) for t in SPECIAL_TOKENS[:4]]
+    seqs = [[bos] + [t for s in persona for t in s]]
+    for i, h in enumerate(history):
+        spk = spk2 if (len(history) - i) % 2 == 1 else spk1
+        seqs.append([spk] + h)
+    seqs.append([spk2] + reply + [eos])
+
+    words, types = [], []
+    for seq in seqs:
+        spk = spk2 if seq and seq[0] == spk2 else spk1
+        words.extend(seq)
+        types.extend([spk] * len(seq))
+    labels = [LM_IGNORE] * (len(words) - len(seqs[-1]) + 1) + seqs[-1][1:]
+    return {"input_ids": words, "token_type_ids": types,
+            "lm_labels": labels if lm_labels else [LM_IGNORE] * len(words)}
+
+
+def _synthetic_personachat(num_personalities: int = 12,
+                           dialogs_per: int = 3, seed: int = 5):
+    rng = np.random.RandomState(seed)
+    words = ["i", "like", "cats", "dogs", "music", "pizza", "running",
+             "books", "you", "do", "what", "love", "my", "hobby", "is"]
+
+    def sent():
+        return " ".join(rng.choice(words, size=rng.randint(3, 7)))
+
+    data = []
+    for p in range(num_personalities):
+        personality = [sent() for _ in range(4)]
+        utterances = []
+        history = []
+        for _ in range(dialogs_per):
+            history = history + [sent()]
+            utterances.append({
+                "history": list(history),
+                "candidates": [sent(), sent()],  # gold last
+            })
+        data.append({"personality": personality, "utterances": utterances})
+    return data
+
+
+class FedPERSONA(FedDataset):
+    """dataset_dir layout: ``personachat_self_original.json`` (the standard
+    release: {"train": [...], "valid": [...]}) or synthetic fallback."""
+
+    def __init__(self, *args, tokenizer=None, num_candidates: int = 2,
+                 max_seq_len: int = 128, synthetic: Optional[bool] = None,
+                 **kw):
+        self.tokenizer = tokenizer or HashTokenizer()
+        self.num_candidates = num_candidates
+        self.max_seq_len = max_seq_len
+        self._synthetic = synthetic
+        super().__init__(*args, **kw)
+
+    # --------------------------------------------------------- preparation
+
+    def _raw_corpus(self):
+        fn = os.path.join(self.dataset_dir, "personachat_self_original.json")
+        if os.path.exists(fn) and not self._synthetic:
+            with open(fn) as f:
+                blob = json.load(f)
+            return blob["train"], blob["valid"]
+        if self._synthetic is False:
+            raise FileNotFoundError(f"no personachat json under "
+                                    f"{self.dataset_dir}")
+        if self._synthetic is None:
+            print(f"WARNING: no personachat json under {self.dataset_dir}; "
+                  "generating synthetic dialogues")
+        return (_synthetic_personachat(12, 3, seed=5),
+                _synthetic_personachat(4, 2, seed=6))
+
+    def _pack_split(self, dialogs, by_personality: bool):
+        tok = self.tokenizer
+        C, S = self.num_candidates, self.max_seq_len
+        enc = lambda s: tok.encode(s)
+
+        # group dialogs by personality => natural clients
+        # (reference fed_persona.py: clients are distinct personalities)
+        groups: Dict[str, list] = {}
+        for d in dialogs:
+            key = "\n".join(d["personality"]) if by_personality else "all"
+            groups.setdefault(key, []).append(d)
+
+        rows = {"input_ids": [], "token_type_ids": [], "lm_labels": [],
+                "mc_token_ids": [], "mc_label": []}
+        per_client = []
+        pad_id = tok.convert_tokens_to_ids("<pad>")
+        for key in sorted(groups):
+            n_items = 0
+            for d in groups[key]:
+                persona = [enc(s) for s in d["personality"]]
+                for utt in d["utterances"]:
+                    cands = utt["candidates"][-C:]
+                    history = [enc(h) for h in utt["history"]]
+                    ii = np.full((C, S), pad_id, np.int32)
+                    tt = np.full((C, S), pad_id, np.int32)
+                    ll = np.full((C, S), LM_IGNORE, np.int32)
+                    mc = np.zeros((C,), np.int32)
+                    for j, cand in enumerate(cands):
+                        gold = j == len(cands) - 1
+                        inst = build_input_from_segments(
+                            persona, history, enc(cand), tok,
+                            lm_labels=gold)
+                        ids = inst["input_ids"][:S]
+                        ii[j, :len(ids)] = ids
+                        tt[j, :len(ids)] = inst["token_type_ids"][:S]
+                        ll[j, :len(ids)] = inst["lm_labels"][:S]
+                        mc[j] = len(ids) - 1
+                    rows["input_ids"].append(ii)
+                    rows["token_type_ids"].append(tt)
+                    rows["lm_labels"].append(ll)
+                    rows["mc_token_ids"].append(mc)
+                    rows["mc_label"].append(len(cands) - 1)
+                    n_items += 1
+            per_client.append(n_items)
+        packed = {k: np.stack(v).astype(np.int32)
+                  for k, v in rows.items()}
+        return packed, per_client
+
+    def prepare_datasets(self, download: bool = False) -> None:
+        train_raw, val_raw = self._raw_corpus()
+        train, per_client = self._pack_split(train_raw, by_personality=True)
+        val, _ = self._pack_split(val_raw, by_personality=True)
+        os.makedirs(self.dataset_dir, exist_ok=True)
+        np.savez(os.path.join(self.dataset_dir, "persona_train.npz"), **train)
+        np.savez(os.path.join(self.dataset_dir, "persona_val.npz"), **val)
+        self.write_stats(self.dataset_dir, per_client,
+                         len(val["mc_label"]))
+
+    def _load_arrays(self) -> None:
+        fn = "persona_train.npz" if self.train else "persona_val.npz"
+        with np.load(os.path.join(self.dataset_dir, fn)) as d:
+            self.arrays = {k: d[k] for k in d.files}
+
+
+def persona_collate(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """The arrays are already padded/stacked statically; collate is the
+    identity (kept for API parity with ``personachat_collate_fn``,
+    reference fed_persona.py:360-392)."""
+    return batch

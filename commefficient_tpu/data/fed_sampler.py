@@ -1,0 +1,116 @@
+"""Federated round scheduler with static shapes.
+
+Re-design of the reference ``FedSampler`` (CommEfficient/data_utils/
+fed_sampler.py:5-71), which yields variable-length flat index arrays that the
+torch DataLoader turns into ragged batches. XLA needs static shapes, so each
+round here is a fixed-size triple
+
+    client_ids : (num_workers,)            int64
+    idx        : (num_workers, B)          int64 flat dataset indices
+    mask       : (num_workers, B)          bool validity
+
+with B = ``local_batch_size`` (or ``max_client_batch`` for whole-client
+``-1`` batches). Semantics preserved from the reference:
+
+- data order is permuted *within* each client per epoch (fed_sampler.py:23-26);
+- every round samples ``num_workers`` clients uniformly without replacement
+  from the clients with data remaining (fed_sampler.py:34-45);
+- each sampled client contributes up to B of its remaining items
+  (fed_sampler.py:49-58); with ``local_batch_size == -1`` a client whose
+  dataset exceeds ``max_client_batch`` contributes a chunk per round until
+  exhausted (the reference would yield one unbounded batch — set
+  ``max_client_batch`` >= the largest client for exact parity);
+- iteration stops when every client is exhausted.
+
+Deviation that matches the *driver* rather than the sampler: rounds with
+fewer than ``num_workers`` non-exhausted clients are dropped, because the
+reference driver skips exactly those batches (cv_train.py:205-219).
+Underfull *per-client* batches are kept and masked (the reference driver
+instead skips them for fixed batch sizes; masking trains on strictly more
+data with identical weighting, since every aggregation is datum-weighted).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, NamedTuple, Optional
+
+import numpy as np
+
+
+class Round(NamedTuple):
+    client_ids: np.ndarray  # (num_workers,)
+    idx: np.ndarray         # (num_workers, B)
+    mask: np.ndarray        # (num_workers, B)
+
+
+class FedSampler:
+    def __init__(self, data_per_client: np.ndarray, num_workers: int,
+                 local_batch_size: int, max_client_batch: int = 512,
+                 seed: Optional[int] = None, drop_underfull: bool = True):
+        self.data_per_client = np.asarray(data_per_client, dtype=np.int64)
+        self.num_clients = len(self.data_per_client)
+        self.num_workers = min(num_workers, self.num_clients)
+        if local_batch_size == -1:
+            self.batch = int(max_client_batch)
+        else:
+            self.batch = int(local_batch_size)
+        self.rng = np.random.RandomState(seed)
+        self.drop_underfull = drop_underfull
+        self.offsets = np.concatenate(
+            [[0], np.cumsum(self.data_per_client)[:-1]])
+
+    def epoch_rounds(self) -> int:
+        """Upper bound on rounds this epoch (exact when all clients are the
+        same size); cf. reference ``steps_per_epoch`` (utils.py:315-321)."""
+        per_client_rounds = -(-self.data_per_client // self.batch)
+        return int(per_client_rounds.sum()) // self.num_workers
+
+    def __iter__(self) -> Iterator[Round]:
+        # fresh within-client permutations each epoch
+        perms = [self.offsets[c] + self.rng.permutation(
+            self.data_per_client[c]) for c in range(self.num_clients)]
+        cursor = np.zeros(self.num_clients, dtype=np.int64)
+        while True:
+            remaining = self.data_per_client - cursor
+            alive = np.where(remaining > 0)[0]
+            if len(alive) == 0:
+                return
+            if len(alive) < self.num_workers and self.drop_underfull:
+                return
+            take_n = min(self.num_workers, len(alive))
+            chosen = self.rng.choice(alive, take_n, replace=False)
+
+            W, B = self.num_workers, self.batch
+            client_ids = np.zeros(W, dtype=np.int64)
+            idx = np.zeros((W, B), dtype=np.int64)
+            mask = np.zeros((W, B), dtype=bool)
+            for slot, c in enumerate(chosen):
+                n = int(min(remaining[c], B))
+                start = cursor[c]
+                idx[slot, :n] = perms[c][start:start + n]
+                mask[slot, :n] = True
+                client_ids[slot] = c
+                cursor[c] += n
+            yield Round(client_ids, idx, mask)
+
+
+class ValSampler:
+    """Static-shape validation batching: (B,) index + mask chunks over the
+    val set (reference shards val batches round-robin to workers,
+    fed_aggregator.py:337-364 — here the jitted val step takes one chunk)."""
+
+    def __init__(self, num_items: int, batch_size: int):
+        self.num_items = num_items
+        self.batch = int(batch_size)
+
+    def __iter__(self):
+        for start in range(0, self.num_items, self.batch):
+            n = min(self.batch, self.num_items - start)
+            idx = np.zeros(self.batch, dtype=np.int64)
+            idx[:n] = np.arange(start, start + n)
+            mask = np.zeros(self.batch, dtype=bool)
+            mask[:n] = True
+            yield idx, mask
+
+    def __len__(self):
+        return -(-self.num_items // self.batch)

@@ -1,0 +1,173 @@
+"""Vectorized numpy augmentation/normalization stacks.
+
+The reference composes per-item PIL/torchvision transforms inside DataLoader
+worker processes (CommEfficient/data_utils/transforms.py:17-75). Here a
+transform maps a whole batch dict of arrays at once — one vectorized pass on
+the host per round, NHWC float32 out, ready for ``jax.device_put``.
+
+Normalization constants are the standard dataset statistics, identical to
+the reference's (transforms.py:13-15, 29-30, 44-45, 62-63).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2471, 0.2435, 0.2616], np.float32)
+CIFAR100_MEAN = np.array([0.5071, 0.4867, 0.4408], np.float32)
+CIFAR100_STD = np.array([0.2675, 0.2565, 0.2761], np.float32)
+FEMNIST_MEAN = np.array([0.9637], np.float32)
+FEMNIST_STD = np.array([0.1597], np.float32)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def _normalize(images: np.ndarray, mean, std) -> np.ndarray:
+    x = images.astype(np.float32)
+    if np.issubdtype(images.dtype, np.integer):  # uint8-range sources
+        x = x / 255.0
+    return (x - mean) / std
+
+
+def _random_crop_flip(images: np.ndarray, pad: int,
+                      rng: np.random.Generator,
+                      flip: bool = True,
+                      pad_mode: str = "reflect") -> np.ndarray:
+    """Per-image random shift crop (pad then crop back to original size) and
+    horizontal flip, fully vectorized via one gather."""
+    n, h, w = images.shape[:3]
+    padded = np.pad(images,
+                    [(0, 0), (pad, pad), (pad, pad)] +
+                    [(0, 0)] * (images.ndim - 3),
+                    mode=pad_mode)
+    dy = rng.integers(0, 2 * pad + 1, size=n)
+    dx = rng.integers(0, 2 * pad + 1, size=n)
+    rows = dy[:, None] + np.arange(h)[None, :]          # (n, h)
+    cols = dx[:, None] + np.arange(w)[None, :]          # (n, w)
+    out = padded[np.arange(n)[:, None, None], rows[:, :, None],
+                 cols[:, None, :]]
+    if flip:
+        do_flip = rng.random(n) < 0.5
+        out[do_flip] = out[do_flip, :, ::-1]
+    return out
+
+
+class CifarTrain:
+    """reflect-pad-4 random crop + horizontal flip + normalize
+    (reference cifar10_train_transforms, transforms.py:17-22).
+
+    ``gather_fused(images, idx)``: fused native gather+augment path (C++
+    data-plane, native/fedloader.cpp) used by ``FedDataset.gather`` when the
+    library is built; numerically equivalent augmentation family (same
+    pad/flip/normalize), different RNG stream."""
+
+    def __init__(self, mean=CIFAR10_MEAN, std=CIFAR10_STD, seed: int = 0):
+        self.mean, self.std = mean, std
+        self.rng = np.random.default_rng(seed)
+        self._seed = seed
+        self._calls = 0
+
+    def __call__(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        img = batch["image"]
+        shape = img.shape
+        flat = img.reshape((-1,) + shape[-3:])
+        flat = _random_crop_flip(flat, pad=4, rng=self.rng)
+        out = dict(batch)
+        out["image"] = _normalize(flat.reshape(shape), self.mean, self.std)
+        return out
+
+    def gather_fused(self, images: np.ndarray, idx: np.ndarray):
+        from commefficient_tpu.data import native
+        if images.dtype != np.uint8 or not native.available():
+            return None
+        self._calls += 1
+        return native.gather_augment(
+            images, idx, self.mean, self.std, pad=4, flip=True,
+            seed=(self._seed << 20) + self._calls)
+
+
+class CifarEval:
+    def __init__(self, mean=CIFAR10_MEAN, std=CIFAR10_STD):
+        self.mean, self.std = mean, std
+
+    def __call__(self, batch):
+        out = dict(batch)
+        out["image"] = _normalize(batch["image"], self.mean, self.std)
+        return out
+
+    def gather_fused(self, images: np.ndarray, idx: np.ndarray):
+        from commefficient_tpu.data import native
+        if images.dtype != np.uint8 or not native.available():
+            return None
+        return native.gather_normalize(images, idx, self.mean, self.std)
+
+
+class FemnistTrain:
+    """constant-pad-2 random crop (fill=white) + normalize. The reference
+    additionally applies RandomResizedCrop/RandomRotation (transforms.py:47-52)
+    which need per-image resampling; the shift-crop captures the dominant
+    augmentation while staying one vectorized gather."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = np.random.default_rng(seed)
+
+    def __call__(self, batch):
+        img = batch["image"]
+        shape = img.shape
+        flat = img.reshape((-1,) + shape[-3:])
+        flat = _random_crop_flip(flat, pad=2, rng=self.rng, flip=False,
+                                 pad_mode="edge")
+        out = dict(batch)
+        out["image"] = _normalize(flat.reshape(shape), FEMNIST_MEAN,
+                                  FEMNIST_STD)
+        return out
+
+
+class FemnistEval:
+    def __call__(self, batch):
+        out = dict(batch)
+        out["image"] = _normalize(batch["image"], FEMNIST_MEAN, FEMNIST_STD)
+        return out
+
+
+class ImagenetTrain:
+    """random horizontal flip + normalize on pre-sized 224 crops. (The
+    reference's RandomResizedCrop runs on variable-size JPEGs; our ImageNet
+    store is pre-resized at prepare time — see fed_imagenet.py.)"""
+
+    def __init__(self, seed: int = 0):
+        self.rng = np.random.default_rng(seed)
+
+    def __call__(self, batch):
+        img = batch["image"]
+        shape = img.shape
+        flat = img.reshape((-1,) + shape[-3:]).copy()
+        do_flip = self.rng.random(flat.shape[0]) < 0.5
+        flat[do_flip] = flat[do_flip, :, ::-1]
+        out = dict(batch)
+        out["image"] = _normalize(flat.reshape(shape), IMAGENET_MEAN,
+                                  IMAGENET_STD)
+        return out
+
+
+class ImagenetEval:
+    def __call__(self, batch):
+        out = dict(batch)
+        out["image"] = _normalize(batch["image"], IMAGENET_MEAN, IMAGENET_STD)
+        return out
+
+
+def transforms_for(dataset_name: str, train: bool, seed: int = 0):
+    if dataset_name == "CIFAR10":
+        return (CifarTrain(seed=seed) if train else CifarEval())
+    if dataset_name == "CIFAR100":
+        return (CifarTrain(CIFAR100_MEAN, CIFAR100_STD, seed=seed)
+                if train else CifarEval(CIFAR100_MEAN, CIFAR100_STD))
+    if dataset_name == "EMNIST":
+        return FemnistTrain(seed=seed) if train else FemnistEval()
+    if dataset_name == "ImageNet":
+        return ImagenetTrain(seed=seed) if train else ImagenetEval()
+    return None

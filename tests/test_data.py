@@ -1,0 +1,136 @@
+"""Data layer: partition math, sampler coverage, transform shapes.
+
+The sampler tests are property tests of the semantics preserved from the
+reference FedSampler (data_utils/fed_sampler.py:19-68): within-epoch
+permutation per client, sampling without replacement, exhaustion semantics.
+"""
+
+import numpy as np
+import pytest
+
+from commefficient_tpu.data import (
+    FedCIFAR10,
+    FedEMNIST,
+    FedSampler,
+    ValSampler,
+    transforms_for,
+)
+
+
+@pytest.fixture(scope="module")
+def cifar_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cifar")
+    ds = FedCIFAR10(str(d), synthetic=True, synthetic_per_class=16)
+    return str(d), ds
+
+
+def test_cifar_partition(cifar_dir):
+    _, ds = cifar_dir
+    assert ds.num_clients == 10
+    assert len(ds) == 160
+    np.testing.assert_array_equal(ds.images_per_client, [16] * 10)
+    # train target == natural client id
+    batch = ds.gather(np.arange(len(ds)))
+    expected = np.repeat(np.arange(10), 16)
+    np.testing.assert_array_equal(batch["target"], expected)
+    assert batch["image"].shape == (160, 32, 32, 3)
+
+
+def test_cifar_reload_from_disk(cifar_dir):
+    d, _ = cifar_dir
+    ds2 = FedCIFAR10(d)  # stats.json exists; no synthetic needed
+    assert len(ds2) == 160
+
+
+def test_cifar_val(cifar_dir):
+    d, _ = cifar_dir
+    val = FedCIFAR10(d, train=False)
+    assert len(val) == val.num_val_images > 0
+    b = val.gather(np.arange(4))
+    assert b["image"].shape == (4, 32, 32, 3)
+
+
+def test_data_per_client_sharding(cifar_dir):
+    _, _ = cifar_dir
+    ds = FedCIFAR10(cifar_dir[0], num_clients=20)
+    per = ds.data_per_client
+    assert len(per) == 20 and per.sum() == 160
+    # each class split across 2 synthetic clients (reference
+    # fed_dataset.py:41-48)
+    np.testing.assert_array_equal(per, [8] * 20)
+
+
+def test_iid_partition(cifar_dir):
+    ds = FedCIFAR10(cifar_dir[0], do_iid=True, num_clients=7)
+    per = ds.data_per_client
+    assert per.sum() == 160 and len(per) == 7
+    assert per.max() - per.min() <= 1
+
+
+def test_sampler_covers_epoch_exactly_once():
+    per_client = np.array([10, 7, 13, 10])
+    s = FedSampler(per_client, num_workers=2, local_batch_size=4, seed=0,
+                   drop_underfull=False)
+    seen = []
+    for rnd in s:
+        assert rnd.idx.shape == (2, 4) and rnd.mask.shape == (2, 4)
+        seen.extend(rnd.idx[rnd.mask].tolist())
+        # valid indices must belong to the claimed client
+        offsets = np.concatenate([[0], np.cumsum(per_client)])
+        for slot in range(2):
+            c = rnd.client_ids[slot]
+            vals = rnd.idx[slot][rnd.mask[slot]]
+            if len(vals):
+                assert (vals >= offsets[c]).all()
+                assert (vals < offsets[c + 1]).all()
+    assert sorted(seen) == list(range(per_client.sum()))
+
+
+def test_sampler_drop_underfull_stops_early():
+    per_client = np.array([100, 1])
+    s = FedSampler(per_client, num_workers=2, local_batch_size=8, seed=0)
+    rounds = list(s)
+    # client 1 exhausts after its first appearance; afterwards only client 0
+    # remains and rounds must stop (reference driver skip, cv_train.py:205-219)
+    for rnd in rounds:
+        assert len(np.unique(rnd.client_ids)) == 2
+
+
+def test_sampler_whole_client_batches():
+    per_client = np.array([5, 3, 4])
+    s = FedSampler(per_client, num_workers=3, local_batch_size=-1,
+                   max_client_batch=8, seed=1, drop_underfull=False)
+    rounds = list(s)
+    # every client's whole dataset fits in one round here
+    assert len(rounds) == 1
+    np.testing.assert_array_equal(np.sort(rounds[0].mask.sum(axis=1)),
+                                  [3, 4, 5])
+
+
+def test_val_sampler():
+    chunks = list(ValSampler(num_items=10, batch_size=4))
+    assert len(chunks) == 3
+    total = sum(m.sum() for _, m in chunks)
+    assert total == 10
+
+
+def test_transforms_cifar():
+    t = transforms_for("CIFAR10", train=True, seed=0)
+    batch = {"image": np.random.randint(0, 255, (3, 5, 32, 32, 3),
+                                        dtype=np.uint8),
+             "target": np.zeros((3, 5), np.int64)}
+    out = t(batch)
+    assert out["image"].shape == (3, 5, 32, 32, 3)
+    assert out["image"].dtype == np.float32
+    # normalized: roughly centered
+    assert abs(float(out["image"].mean())) < 3.0
+
+
+def test_emnist_synthetic(tmp_path):
+    ds = FedEMNIST(str(tmp_path), synthetic=True)
+    assert ds.num_clients == 20
+    b = ds.gather(np.arange(6))
+    assert b["image"].shape == (6, 28, 28, 1)
+    t = transforms_for("EMNIST", train=True)
+    out = t(b)
+    assert out["image"].shape == (6, 28, 28, 1)
