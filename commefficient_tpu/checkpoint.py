@@ -1,0 +1,121 @@
+"""Full-state checkpoint / resume.
+
+The reference can only save final model weights (``--checkpoint``,
+cv_train.py:418-421) — no optimizer/error/momentum state is ever saved, so a
+crash loses the run (SURVEY.md §5 "no mid-run resume"). Here the WHOLE
+``FedState`` pytree — PS weights, virtual momentum/error, per-client rows,
+byte-accounting arrays, PRNG key, round counter — round-trips losslessly,
+making mid-run resume exact: a resumed run continues the same trajectory.
+
+Format: a single ``.npz`` per checkpoint (+ ``meta.json`` sidecar), atomic
+rename on save, ``keep_last`` rotation. Orbax is deliberately not used: the
+state is a flat dozen arrays, and a dependency-free format stays robust
+across environments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import tempfile
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from commefficient_tpu.core.state import FedState
+
+_FIELDS = [f.name for f in dataclasses.fields(FedState)]
+
+
+def save_state(path: str, state: FedState,
+               meta: Optional[Dict] = None) -> str:
+    """Write ``<path>.npz`` (+ ``<path>.meta.json``) atomically."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arrays = {}
+    for name in _FIELDS:
+        val = getattr(state, name)
+        if val is not None:
+            arrays[name] = np.asarray(val)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               suffix=".tmp")
+    os.close(fd)
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path + ".npz")
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    with open(path + ".meta.json", "w") as f:
+        json.dump(meta or {}, f)
+    return path + ".npz"
+
+
+def load_state(path: str, sharding=None) -> FedState:
+    """Rebuild a FedState; optional sharding pytree (from
+    ``FedRuntime._state_sharding``) places arrays sharded on load."""
+    with np.load(path + ".npz") as z:
+        kw = {name: (jax.numpy.asarray(z[name]) if name in z.files else None)
+              for name in _FIELDS}
+    state = FedState(**kw)
+    if sharding is not None:
+        state = jax.device_put(state, sharding)
+    return state
+
+
+def load_meta(path: str) -> Dict:
+    fn = path + ".meta.json"
+    if not os.path.exists(fn):
+        return {}
+    with open(fn) as f:
+        return json.load(f)
+
+
+class CheckpointManager:
+    """Rotating checkpoints under ``directory``: ``ckpt_<epoch>``,
+    keeping the newest ``keep_last``."""
+
+    def __init__(self, directory: str, keep_last: int = 3):
+        self.directory = directory
+        self.keep_last = keep_last
+
+    def _path(self, epoch: int) -> str:
+        return os.path.join(self.directory, f"ckpt_{epoch:06d}")
+
+    def save(self, state: FedState, epoch: int,
+             meta: Optional[Dict] = None) -> str:
+        meta = dict(meta or {}, epoch=epoch)
+        out = save_state(self._path(epoch), state, meta)
+        self._rotate()
+        return out
+
+    def _rotate(self) -> None:
+        for e in self.epochs()[: -self.keep_last]:
+            for suffix in (".npz", ".meta.json"):
+                fn = self._path(e) + suffix
+                if os.path.exists(fn):
+                    os.unlink(fn)
+
+    def epochs(self):
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for fn in os.listdir(self.directory):
+            if fn.startswith("ckpt_") and fn.endswith(".npz"):
+                out.append(int(fn[len("ckpt_"):-len(".npz")]))
+        return sorted(out)
+
+    def latest(self) -> Optional[int]:
+        es = self.epochs()
+        return es[-1] if es else None
+
+    def restore_latest(self, sharding=None):
+        """Returns (state, meta) or (None, {})."""
+        e = self.latest()
+        if e is None:
+            return None, {}
+        return (load_state(self._path(e), sharding=sharding),
+                load_meta(self._path(e)))

@@ -56,6 +56,11 @@ class FedConfig:
     do_finetune: bool = False
     do_checkpoint: bool = False
     checkpoint_path: str = "./checkpoint"
+    # TPU-native improvement over the reference (which can only save final
+    # weights, cv_train.py:418-421): periodic full-FedState checkpoints and
+    # exact mid-run resume (see checkpoint.py)
+    checkpoint_every: int = 0     # epochs between mid-run checkpoints; 0=off
+    do_resume: bool = False
     finetune_path: str = "./finetune"
     finetuned_from: Optional[str] = None
     do_batchnorm: bool = False
@@ -193,6 +198,8 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--finetune", action="store_true", dest="do_finetune")
     p.add_argument("--checkpoint", action="store_true", dest="do_checkpoint")
     p.add_argument("--checkpoint_path", type=str, default="./checkpoint")
+    p.add_argument("--checkpoint_every", type=int, default=0)
+    p.add_argument("--resume", action="store_true", dest="do_resume")
     p.add_argument("--finetune_path", type=str, default="./finetune")
     p.add_argument("--finetuned_from", type=str, choices=list(FED_DATASETS))
     p.add_argument("--num_results_train", type=int, default=2)

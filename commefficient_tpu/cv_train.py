@@ -1,0 +1,339 @@
+"""CV experiment driver: federated ResNets on CIFAR10/100, FEMNIST, ImageNet.
+
+Parity target: reference CommEfficient/cv_train.py (421 LoC) — same flag
+surface, same five modes, same epoch loop shape (fractional epochs, skip
+underfull rounds, NaN abort, per-epoch TableLogger/TSV rows with train/test
+loss+acc and simulated per-client down/up MiB, end-of-run checkpoint),
+driven by the same triangular LR schedule (0 -> lr_scale @ pivot_epoch -> 0).
+
+Run:  python -m commefficient_tpu.cv_train --dataset_name CIFAR10 \
+          --model ResNet9 --mode sketch --error_type virtual ...
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu import models
+from commefficient_tpu.config import FedConfig, num_classes_of_dataset, parse_args
+from commefficient_tpu.core import FedRuntime
+from commefficient_tpu.data import (
+    FedSampler,
+    ValSampler,
+    get_dataset,
+    transforms_for,
+)
+from commefficient_tpu.losses import make_cv_loss
+from commefficient_tpu.utils import (
+    PiecewiseLinear,
+    TableLogger,
+    TSVLogger,
+    Timer,
+    make_logdir,
+)
+
+
+def fixup_lr_multiplier(params, unravel_shape_ref: jax.Array) -> jax.Array:
+    """Per-parameter LR multipliers for Fixup models: 0.1 on scalar
+    bias/scale params, 1.0 elsewhere (reference param groups,
+    cv_train.py:361-371 + FedOptimizer.get_lr, fed_aggregator.py:411-427)."""
+    flat_paths = jax.tree_util.tree_flatten_with_path(params)[0]
+    pieces = []
+    for path, leaf in flat_paths:
+        names = "/".join(str(getattr(p, "key", p)) for p in path)
+        mult = 0.1 if ("bias" in names or "scale" in names) else 1.0
+        pieces.append(np.full(int(np.prod(leaf.shape)), mult, np.float32))
+    vec = np.concatenate(pieces)
+    assert vec.size == unravel_shape_ref.size
+    return jnp.asarray(vec)
+
+
+def build_model(cfg: FedConfig, num_classes: int):
+    kwargs = {"num_classes": num_classes}
+    if cfg.do_test:
+        # tiny model for the smoke path (reference cv_train.py:329-336)
+        kwargs["channels"] = {"prep": 1, "layer1": 1, "layer2": 1,
+                              "layer3": 1}
+    ctor = models.get_model(cfg.model)
+    if cfg.model == "ResNet9":
+        kwargs["do_batchnorm"] = cfg.do_batchnorm
+    elif cfg.model != "FixupResNet9":
+        kwargs.pop("channels", None)
+    return ctor(**kwargs)
+
+
+def build_mesh(cfg: FedConfig):
+    """Honor --mesh_shape/--mesh_axes (TPU-native flags): returns a Mesh or
+    None for plain single-device jit."""
+    if not cfg.mesh_shape:
+        return None
+    from commefficient_tpu.parallel import make_mesh
+    mesh = make_mesh(cfg.mesh_shape, cfg.mesh_axes)
+    if mesh is not None:
+        n = mesh.shape[mesh.axis_names[0]]
+        if cfg.num_workers % n != 0:
+            raise ValueError(
+                f"--num_workers {cfg.num_workers} must be divisible by the "
+                f"mesh axis size {n}")
+    return mesh
+
+
+def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
+    """Shared --checkpoint/--checkpoint_every/--resume wiring.
+    Returns (ckpt_mgr_or_None, start_epoch, restored_state_or_None)."""
+    if not (cfg.do_checkpoint or cfg.do_resume or cfg.checkpoint_every):
+        return None, 0, None
+    from commefficient_tpu.checkpoint import CheckpointManager
+    mgr = CheckpointManager(os.path.join(cfg.checkpoint_path, name))
+    if cfg.do_resume:
+        restored, meta = mgr.restore_latest(
+            sharding=runtime._state_sharding)
+        if restored is not None:
+            start = int(meta.get("epoch", 0))
+            print(f"resumed from epoch {start}")
+            return mgr, start, restored
+    return mgr, 0, None
+
+
+def build_datasets(cfg: FedConfig):
+    ds_cls = get_dataset(cfg.dataset_name)
+    kw = {}
+    if cfg.do_test:
+        kw = {"synthetic": True}
+    train_ds = ds_cls(cfg.dataset_dir, train=True, do_iid=cfg.do_iid,
+                      num_clients=cfg.num_clients,
+                      transform=transforms_for(cfg.dataset_name, True,
+                                               seed=cfg.seed), **kw)
+    val_ds = ds_cls(cfg.dataset_dir, train=False,
+                    transform=transforms_for(cfg.dataset_name, False), **kw)
+    return train_ds, val_ds
+
+
+def run_validation(runtime: FedRuntime, state, val_ds, cfg: FedConfig):
+    losses, accs, weights = [], [], []
+    for idx, mask in ValSampler(len(val_ds), cfg.valid_batch_size):
+        batch = val_ds.gather(idx)
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        results, n_valid = runtime.val(state, batch, jnp.asarray(mask))
+        w = float(n_valid)
+        if w == 0:
+            continue
+        losses.append(float(results[0]) * w)
+        accs.append(float(results[1]) * w)
+        weights.append(w)
+        if cfg.do_test:
+            break
+    total = max(sum(weights), 1.0)
+    return sum(losses) / total, sum(accs) / total
+
+
+def make_writer(cfg: FedConfig):
+    """TensorBoard writer when --tensorboard is set (reference utils.py:51-64
+    + cv_train.py:407-411); gated on torch's SummaryWriter being available."""
+    if not cfg.use_tensorboard:
+        return None
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+    except Exception:
+        print("WARNING: --tensorboard set but SummaryWriter unavailable")
+        return None
+    return SummaryWriter(log_dir=make_logdir(cfg))
+
+
+def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
+          lr_mult: Optional[jax.Array] = None, loggers=(), timer=None,
+          ckpt_mgr=None, start_epoch: int = 0, writer=None):
+    timer = timer or Timer()
+    schedule = PiecewiseLinear(
+        [0.0, cfg.pivot_epoch, float(cfg.num_epochs)],
+        [0.0, cfg.lr_scale if cfg.lr_scale is not None else 0.4, 0.0])
+
+    # one sampler per epoch, seeded by (seed, epoch): an interrupted run
+    # resumed at epoch E replays exactly the round sequence the
+    # uninterrupted run would have used from epoch E on (see checkpoint.py)
+    def epoch_sampler(epoch: int) -> FedSampler:
+        return FedSampler(train_ds.data_per_client, cfg.num_workers,
+                          cfg.local_batch_size,
+                          max_client_batch=cfg.max_client_batch,
+                          seed=cfg.seed + 7919 * epoch)
+
+    spe = max(epoch_sampler(0).epoch_rounds(), 1)
+    total_download_mb = total_upload_mb = 0.0
+    global_round = start_epoch * spe
+    summary = None
+
+    if cfg.eval_before_start:
+        test_loss, test_acc = run_validation(runtime, state, val_ds, cfg)
+        print(f"Test acc at epoch 0: {test_acc:0.4f}")
+
+    for epoch in range(start_epoch, math.ceil(cfg.num_epochs)):
+        epoch_fraction = (cfg.num_epochs - epoch
+                          if epoch == math.ceil(cfg.num_epochs) - 1 else 1.0)
+        ep_losses, ep_accs, ep_weights = [], [], []
+        ep_download = ep_upload = 0.0
+        for i, rnd in enumerate(epoch_sampler(epoch)):
+            # fractional final epoch (reference cv_train.py:194-196)
+            if i >= spe * epoch_fraction:
+                break
+            global_round += 1
+            lr = schedule(global_round / spe)
+            lr_arr = (jnp.asarray(lr, jnp.float32) if lr_mult is None
+                      else lr * lr_mult)
+            batch = train_ds.gather(rnd.idx)
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            state, metrics = runtime.round(
+                state, rnd.client_ids, batch, rnd.mask, lr_arr)
+            losses = np.asarray(metrics["results"][0])
+            if np.any(np.isnan(losses)):
+                print(f"LOSS OF {losses.mean()} IS NAN, TERMINATING TRAINING")
+                return state, None
+            n_valid = np.asarray(metrics["n_valid"])
+            ep_losses.append(float((losses * n_valid).sum()))
+            ep_accs.append(
+                float((np.asarray(metrics["results"][1]) * n_valid).sum()))
+            ep_weights.append(float(n_valid.sum()))
+            if cfg.track_bytes:
+                ep_download += float(
+                    np.asarray(metrics["download_bytes"]).sum())
+                ep_upload += float(np.asarray(metrics["upload_bytes"]).sum())
+            if cfg.do_test:
+                break
+
+        train_time = timer()
+        total = max(sum(ep_weights), 1.0)
+        train_loss = sum(ep_losses) / total
+        train_acc = sum(ep_accs) / total
+        download_mb = ep_download / (1024 * 1024)
+        upload_mb = ep_upload / (1024 * 1024)
+        total_download_mb += download_mb
+        total_upload_mb += upload_mb
+
+        test_loss, test_acc = run_validation(runtime, state, val_ds, cfg)
+        test_time = timer()
+
+        summary = {
+            "epoch": epoch + 1,
+            "lr": schedule(global_round / spe),
+            "train_time": train_time,
+            "train_loss": train_loss,
+            "train_acc": train_acc,
+            "test_loss": test_loss,
+            "test_acc": test_acc,
+            "down (MiB)": round(download_mb),
+            "up (MiB)": round(upload_mb),
+            "total_time": timer.total_time,
+        }
+        for logger in loggers:
+            logger.append(summary)
+        if writer is not None:
+            # reference scalar set (cv_train.py:150-158)
+            writer.add_scalar("Loss/train", train_loss, epoch)
+            writer.add_scalar("Loss/test", test_loss, epoch)
+            writer.add_scalar("Acc/train", train_acc, epoch)
+            writer.add_scalar("Acc/test", test_acc, epoch)
+            writer.add_scalar("Time/train", train_time, epoch)
+            writer.add_scalar("Time/test", test_time, epoch)
+            writer.add_scalar("Time/total", timer.total_time, epoch)
+            writer.add_scalar("Lr", summary["lr"], epoch)
+        if (ckpt_mgr is not None and cfg.checkpoint_every
+                and (epoch + 1) % cfg.checkpoint_every == 0):
+            ckpt_mgr.save(state, epoch + 1, meta={"summary": summary})
+        if cfg.do_test:
+            break
+
+    n_clients = train_ds.num_clients
+    print(f"Total Download (MiB): {total_download_mb:0.2f}")
+    print(f"Total Upload (MiB): {total_upload_mb:0.2f}")
+    print(f"Avg Download Per Client: {total_download_mb / n_clients:0.2f}")
+    print(f"Avg Upload Per Client: {total_upload_mb / n_clients:0.2f}")
+    return state, summary
+
+
+def main(argv=None):
+    cfg = parse_args(argv, default_lr=0.4)
+    np.random.seed(cfg.seed)
+    if cfg.do_test:
+        # shrink sketch to smoke size (reference cv_train.py:329-336)
+        cfg = cfg.replace(num_cols=10, num_rows=1, k=10)
+
+    timer = Timer()
+    train_ds, val_ds = build_datasets(cfg)
+    cfg = cfg.replace(num_clients=train_ds.num_clients)
+
+    num_classes = num_classes_of_dataset(
+        cfg.finetuned_from if cfg.do_finetune else cfg.dataset_name)
+    model = build_model(cfg, num_classes)
+
+    sample = train_ds.gather(np.zeros((1,), np.int64))
+    init_x = jnp.asarray(sample["image"])
+    params = model.init(jax.random.PRNGKey(cfg.seed), init_x)
+
+    frozen = None
+    if cfg.do_finetune:
+        params, frozen = load_finetune_params(cfg, model, params)
+
+    loss_fn = make_cv_loss(model, cfg.compute_dtype, frozen_params=frozen)
+    runtime = FedRuntime(cfg, params, loss_fn,
+                         num_clients=train_ds.num_clients,
+                         mesh=build_mesh(cfg))
+    state = runtime.init_state()
+
+    lr_mult = None
+    if cfg.model.startswith("Fixup"):
+        print("using fixup learning rates")
+        lr_mult = fixup_lr_multiplier(params, runtime.initial_weights)
+
+    ckpt_mgr, start_epoch, restored = setup_checkpointing(
+        cfg, runtime, cfg.model)
+    if restored is not None:
+        state = restored
+
+    print(f"Finished initializing in {timer():.2f} seconds")
+    tsv = TSVLogger()
+    state, summary = train(cfg, runtime, state, train_ds, val_ds,
+                           lr_mult=lr_mult, loggers=(TableLogger(), tsv),
+                           timer=timer, ckpt_mgr=ckpt_mgr,
+                           start_epoch=start_epoch, writer=make_writer(cfg))
+    print(tsv)
+
+    if cfg.do_checkpoint and summary is not None:
+        os.makedirs(cfg.checkpoint_path, exist_ok=True)
+        path = os.path.join(cfg.checkpoint_path, cfg.model + ".npz")
+        np.savez(path, ps_weights=np.asarray(state.ps_weights))
+        print(f"saved checkpoint to {path}")
+    return summary
+
+
+def load_finetune_params(cfg: FedConfig, model, params):
+    """Finetune mode (reference cv_train.py:342-352, 377-384): load saved
+    weights, then split the pytree into the trainable head and the frozen
+    backbone, so the federated vector covers only the head."""
+    path = os.path.join(cfg.finetune_path, cfg.model + ".npz")
+    loaded = np.load(path)["ps_weights"]
+    from commefficient_tpu.ops import ravel_params
+    _, unravel = ravel_params(params)
+    full = unravel(jnp.asarray(loaded))
+    head_keys = [k for k in full["params"]
+                 if k in ("head", "classifier", "fc")]
+    assert head_keys, "no recognisable head to finetune"
+    num_new = num_classes_of_dataset(cfg.dataset_name)
+    # re-init the head at the new class count (reference
+    # finetune_parameters, models/resnet9.py:105-113)
+    sample_head = params["params"][head_keys[0]]
+    new_head = jax.tree.map(
+        lambda t: jnp.zeros(t.shape[:-1] + (num_new,), t.dtype), sample_head)
+    trainable = {"params": {head_keys[0]: new_head}}
+    frozen = {"params": {k: v for k, v in full["params"].items()
+                         if k not in head_keys}}
+    return trainable, frozen
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Loss builders satisfying the core.client loss contract.
+
+The reference passes ``compute_loss_train`` / ``compute_loss_val`` closures
+into ``FedModel`` (cv_train.py:67-83, 389); here the equivalent closures map
+``(params_pytree, batch_dict, mask) -> (mean_loss, (metrics...))`` with masked
+means, and own the mixed-precision policy: parameters are cast to
+``compute_dtype`` (bfloat16 by default — the MXU-native dtype) for the
+forward/backward while the federated vector and all server state stay fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _cast(tree, dtype):
+    return jax.tree.map(
+        lambda t: t.astype(dtype) if jnp.issubdtype(t.dtype, jnp.floating)
+        else t, tree)
+
+
+def _gpt2_losses(model, params, batch, mask):
+    """Shared DoubleHeads forward: (lm_nll_per_token, mc_loss, mc_acc)."""
+    lm_logits, mc_logits = model.apply(
+        params, batch["input_ids"], batch["mc_token_ids"],
+        batch["token_type_ids"])
+    m = mask.astype(jnp.float32)                      # (B,)
+
+    sh_logits = lm_logits[..., :-1, :]                # (B, C, S-1, V)
+    sh_labels = batch["lm_labels"][..., 1:]           # (B, C, S-1)
+    tok_valid = ((sh_labels != -100)
+                 * m[:, None, None]).astype(jnp.float32)
+    safe_labels = jnp.maximum(sh_labels, 0)
+    logp = jax.nn.log_softmax(sh_logits)
+    tok_nll = -jnp.take_along_axis(
+        logp, safe_labels[..., None], axis=-1)[..., 0]
+    lm_loss = (tok_nll * tok_valid).sum() / jnp.maximum(tok_valid.sum(), 1.0)
+
+    mc_logp = jax.nn.log_softmax(mc_logits, axis=-1)  # (B, C)
+    mc_nll = -jnp.take_along_axis(
+        mc_logp, batch["mc_label"][:, None], axis=-1)[:, 0]
+    denom = jnp.maximum(m.sum(), 1.0)
+    mc_loss = (mc_nll * m).sum() / denom
+    acc = (((jnp.argmax(mc_logits, -1) == batch["mc_label"]) * m).sum()
+           / denom)
+    return lm_loss, mc_loss, acc
+
+
+def make_gpt2_train_loss(model, lm_coef: float = 1.0, mc_coef: float = 1.0):
+    """DoubleHeads training loss (reference gpt2_train.py:88-99):
+    ``lm_coef * lm_loss + mc_coef * mc_loss`` where the LM loss is shifted
+    cross-entropy over the gold candidate's reply tokens and the MC loss is
+    cross-entropy over candidates. Metrics: (mc accuracy,)."""
+
+    def loss_fn(params, batch, mask):
+        lm_loss, mc_loss, acc = _gpt2_losses(model, params, batch, mask)
+        return lm_coef * lm_loss + mc_coef * mc_loss, (acc,)
+
+    return loss_fn
+
+
+def make_gpt2_val_loss(model):
+    """Validation metrics (reference test_gpt2, gpt2_train.py:55-86):
+    per-token LM NLL (=> ppl on the host) and MC accuracy."""
+
+    def loss_fn(params, batch, mask):
+        lm_loss, _, acc = _gpt2_losses(model, params, batch, mask)
+        return lm_loss, (acc,)
+
+    return loss_fn
+
+
+def make_cv_loss(model, compute_dtype: str = "bfloat16",
+                 frozen_params=None) -> Callable:
+    """Masked softmax cross-entropy + top-1 accuracy (reference
+    compute_loss_train/val, cv_train.py:67-83).
+
+    ``frozen_params``: optional pytree of non-trained parameters (finetune
+    mode — the reference shrinks the federated vector to just the trainable
+    head, cv_train.py:377-384); merged under the trained params at apply time.
+    """
+    dtype = jnp.dtype(compute_dtype)
+
+    def loss_fn(params, batch, mask) -> Tuple[jax.Array, Tuple[jax.Array]]:
+        if frozen_params is not None:
+            params = {"params": {**frozen_params["params"],
+                                 **params["params"]}}
+        x = batch["image"].astype(dtype)
+        logits = model.apply(_cast(params, dtype), x).astype(jnp.float32)
+        labels = batch["target"]
+        logp = jax.nn.log_softmax(logits)
+        ce = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        m = mask.astype(jnp.float32)
+        denom = jnp.maximum(m.sum(), 1.0)
+        loss = (ce * m).sum() / denom
+        acc = ((jnp.argmax(logits, axis=1) == labels) * m).sum() / denom
+        return loss, (acc,)
+
+    return loss_fn

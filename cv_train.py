@@ -1,0 +1,9 @@
+#!/usr/bin/env python
+"""Entry point kept at the repo root for reference-invocation parity:
+``python cv_train.py --mode sketch ...`` (reference CommEfficient/cv_train.py).
+"""
+
+from commefficient_tpu.cv_train import main
+
+if __name__ == "__main__":
+    main()

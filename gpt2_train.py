@@ -1,0 +1,9 @@
+#!/usr/bin/env python
+"""Entry point kept at the repo root for reference-invocation parity:
+``python gpt2_train.py ...`` (reference CommEfficient/gpt2_train.py).
+"""
+
+from commefficient_tpu.gpt2_train import main
+
+if __name__ == "__main__":
+    main()

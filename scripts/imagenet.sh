@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# FixupResNet50 / federated ImageNet recipe — the reference's only tuned
+# large-scale config (imagenet.sh:2-21), with its stale flags (--mixup,
+# --supervised) dropped and the process-placement flags replaced by the TPU
+# mesh. Expects the ImageNet train/ tree (or synthetic fallback) under
+# $DATASET_DIR.
+set -e
+DATASET_DIR=${DATASET_DIR:-./dataset/imagenet}
+MESH=${MESH:-8}
+
+python cv_train.py \
+    --dataset_name ImageNet \
+    --model FixupResNet50 \
+    --mode uncompressed \
+    --error_type virtual \
+    --virtual_momentum 0.9 \
+    --local_momentum 0 \
+    --weight_decay 1e-4 \
+    --num_epochs 24 \
+    --pivot_epoch 2 \
+    --lr_scale 0.4 \
+    --num_workers 7 \
+    --num_clients 7 \
+    --iid \
+    --local_batch_size 64 \
+    --valid_batch_size 64 \
+    --dataset_dir "$DATASET_DIR" \
+    --mesh_shape "$MESH" \
+    --checkpoint --checkpoint_every 1 \
+    "$@"
