@@ -48,20 +48,33 @@ class FedRuntime:
     batch_size : static per-client batch (local_batch_size, or
         max_client_batch when local_batch_size == -1)
     num_clients : total simulated clients
+    mesh : optional jax.sharding.Mesh; when given, the round is pjit-sharded
+        per parallel.mesh.FedShardings (clients over the mesh axis, dense
+        federated vectors sharded, XLA inserts the ICI collectives)
     """
 
     def __init__(self, cfg: FedConfig, params: Any,
                  loss_fn_train: Callable,
                  loss_fn_val: Optional[Callable] = None,
-                 num_clients: Optional[int] = None):
+                 num_clients: Optional[int] = None,
+                 mesh=None):
         flat, unravel = ravel_params(params)
         cfg = cfg.replace(grad_size=int(flat.size))
         validate_mode_combo(cfg)
         self.cfg = cfg
         self.unravel = unravel
         self.initial_weights = flat
+        self.mesh = mesh
         self.num_clients = (num_clients if num_clients is not None
                             else cfg.default_num_clients())
+        if mesh is not None:
+            # pad the client-state row count up to a mesh-divisible size
+            from commefficient_tpu.parallel.mesh import FedShardings
+            self.shardings = FedShardings(mesh)
+            n_dev = mesh.shape[self.shardings.axis]
+            self.num_clients = -(-self.num_clients // n_dev) * n_dev
+        else:
+            self.shardings = None
         self.batch_size = (cfg.local_batch_size if cfg.local_batch_size > 0
                            else cfg.max_client_batch)
         self.cs = None
@@ -78,12 +91,39 @@ class FedRuntime:
                 cfg, loss_fn_train, unravel, self.batch_size, self.cs)
         self._val_fn_inner = client_lib.make_val_step(cfg, loss_fn_val, unravel)
 
-        self._round = jax.jit(self._round_step, donate_argnums=(0,))
+        if self.shardings is not None:
+            sh = self.shardings
+            state_sh = sh.for_state(cfg, self._state_template())
+            batch_leaf = sh.round_axis
+            self._round = jax.jit(
+                self._round_step,
+                donate_argnums=(0,),
+                in_shardings=(state_sh, batch_leaf, batch_leaf, batch_leaf,
+                              None),
+                out_shardings=(state_sh, None),
+            )
+            self._state_sharding = state_sh
+        else:
+            self._round = jax.jit(self._round_step, donate_argnums=(0,))
+            self._state_sharding = None
         self._val = jax.jit(self._val_step)
 
     # ------------------------------------------------------------------ state
 
+    def _state_template(self):
+        """Structure-only FedState (no allocation) for sharding layout."""
+        return jax.eval_shape(self._make_state, 0)
+
     def init_state(self, seed: Optional[int] = None) -> FedState:
+        seed = self.cfg.seed if seed is None else seed
+        if self._state_sharding is not None:
+            # create the state directly in its sharded layout — no single
+            # device ever holds the full per-client arrays
+            return jax.jit(self._make_state,
+                           out_shardings=self._state_sharding)(seed)
+        return self._make_state(seed)
+
+    def _make_state(self, seed) -> FedState:
         cfg = self.cfg
         tx = cfg.transmitted_shape
         d = cfg.grad_size
@@ -94,11 +134,13 @@ class FedRuntime:
             return jnp.zeros(shape, jnp.float32) if cond else None
 
         return FedState(
-            ps_weights=self.initial_weights,
+            # copy: the round step donates its input state, and the shared
+            # self.initial_weights buffer must survive repeated init_state()
+            ps_weights=jnp.array(self.initial_weights, copy=True),
             Vvelocity=zeros_tx,
             Verror=jnp.zeros_like(zeros_tx),
             step=jnp.zeros((), jnp.int32),
-            rng=jax.random.PRNGKey(cfg.seed if seed is None else seed),
+            rng=jax.random.PRNGKey(seed),
             client_velocities=maybe((n,) + tx, cfg.needs_client_velocities),
             client_errors=maybe((n,) + tx, cfg.needs_client_errors),
             # every client starts with the initial PS weights

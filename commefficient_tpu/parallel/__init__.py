@@ -1,0 +1,17 @@
+from commefficient_tpu.parallel.mesh import (
+    FedShardings,
+    init_distributed,
+    make_mesh,
+)
+from commefficient_tpu.parallel.ring import (
+    make_ring_attention,
+    ring_attention_inner,
+)
+
+__all__ = [
+    "FedShardings",
+    "init_distributed",
+    "make_mesh",
+    "make_ring_attention",
+    "ring_attention_inner",
+]

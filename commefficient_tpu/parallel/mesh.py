@@ -1,0 +1,129 @@
+"""Device mesh construction and the framework's sharding layout.
+
+This is the TPU-native replacement for the reference's entire distributed
+stack (SURVEY.md §2.8): the PS + per-GPU-worker process split, the
+multiprocessing queues, /dev/shm tensors and the NCCL ``reduce`` all
+collapse into sharding annotations on ONE jitted program. XLA inserts the
+ICI collectives (psum for the client-gradient sum, all-gathers around the
+top-k) exactly where the reference hand-placed NCCL calls
+(fed_worker.py:138, fed_aggregator.py:329).
+
+Layout (single mesh axis, default name "clients"):
+- the round's client axis (leading dim of batch/client_ids/mask and of the
+  per-client persistent state arrays) is sharded over the axis — each device
+  simulates ``num_workers / n_devices`` clients, the TPU analogue of the
+  reference's one-GPU-per-worker-process;
+- the dense (d,) federated vectors (ps_weights, Vvelocity, Verror, updates)
+  are sharded over the same axis — server math is elementwise, so it
+  partitions perfectly; XLA all-gathers only where globality is required
+  (``lax.top_k``);
+- count-sketch tables (r, c) shard their column axis;
+- scalars and PRNG keys replicate.
+
+Multi-host: ``init_distributed`` wraps ``jax.distributed.initialize`` — the
+DCN equivalent of the reference's (vestigial, 127.0.0.1-hardcoded) NCCL
+world bring-up (fed_aggregator.py:161-164).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(mesh_shape: Tuple[int, ...] = (),
+              mesh_axes: Tuple[str, ...] = ("clients",),
+              devices=None) -> Optional[Mesh]:
+    """Build a Mesh from config. Empty ``mesh_shape`` with one device =>
+    None (plain single-device jit); empty shape with several devices =>
+    1-D mesh over all of them."""
+    devices = devices if devices is not None else jax.devices()
+    if not mesh_shape:
+        if len(devices) == 1:
+            return None
+        mesh_shape = (len(devices),)
+        mesh_axes = mesh_axes[:1]
+    n = int(np.prod(mesh_shape))
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {mesh_shape} needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(mesh_shape)
+    return Mesh(arr, mesh_axes[:arr.ndim])
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (call once per host before building the mesh)."""
+    kw = {}
+    if coordinator_address is not None:
+        kw = dict(coordinator_address=coordinator_address,
+                  num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kw)
+
+
+class FedShardings:
+    """NamedShardings for every array family in a federated run."""
+
+    def __init__(self, mesh: Mesh, axis: Optional[str] = None):
+        self.mesh = mesh
+        self.axis = axis if axis is not None else mesh.axis_names[0]
+
+    def _ns(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return self._ns()
+
+    @property
+    def dense_vec(self) -> NamedSharding:           # (d,)
+        return self._ns(self.axis)
+
+    @property
+    def sketch_table(self) -> NamedSharding:        # (r, c)
+        return self._ns(None, self.axis)
+
+    @property
+    def client_rows(self) -> NamedSharding:         # (num_clients, ...)
+        return self._ns(self.axis)
+
+    @property
+    def round_axis(self) -> NamedSharding:          # (num_workers, ...)
+        return self._ns(self.axis)
+
+    def transmitted(self, transmitted_shape) -> NamedSharding:
+        return (self.sketch_table if len(transmitted_shape) == 2
+                else self.dense_vec)
+
+    def for_state(self, cfg, state_like) -> "jax.tree_util.PyTreeDef":
+        """Sharding pytree matching a FedState.
+
+        Weight-dimension sharding of the dense (d,) vectors and the sketch
+        column axis is applied only when the dim divides the mesh axis —
+        otherwise those leaves replicate (which is exactly the reference's
+        layout: every process holds the full weight vector,
+        fed_aggregator.py:94-97). Per-client rows always shard (the runtime
+        pads num_clients up to a mesh multiple)."""
+        n = self.mesh.shape[self.axis]
+
+        def leaf(path, like):
+            name = path[0].name
+            if name in ("client_velocities", "client_errors",
+                        "client_weights", "client_last_round"):
+                return self.client_rows
+            if name in ("ps_weights", "coord_last_update", "Vvelocity",
+                        "Verror"):
+                if like.ndim == 2:       # sketch table (r, c)
+                    return (self.sketch_table if like.shape[1] % n == 0
+                            else self.replicated)
+                return (self.dense_vec if like.shape[0] % n == 0
+                        else self.replicated)
+            return self.replicated  # step, rng
+        return jax.tree_util.tree_map_with_path(leaf, state_like)
+
+    def divisible(self, n: int) -> bool:
+        return n % self.mesh.shape[self.axis] == 0
