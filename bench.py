@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Headline benchmark: federated-round throughput, ResNet-9/CIFAR10-shape,
+FetchSGD sketch compression (the reference's flagship config,
+``cv_train.py --mode sketch``).
+
+Measures end-to-end rounds of the jitted federated step — per-client
+forward/backward, count-sketch encode, aggregation, server unsketch/top-k
+update — and reports images/second. ``vs_baseline`` is the ratio against a
+2000 img/s nominal single-GPU figure (cifar10_fast lineage trains CIFAR10 in
+~24 epochs x ~25 s on one V100; the reference publishes no numbers of its
+own — BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+NOMINAL_SINGLE_GPU_IMG_PER_SEC = 2000.0
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu import models
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.core import FedRuntime
+    from commefficient_tpu.losses import make_cv_loss
+
+    log("devices:", jax.devices())
+
+    W, B = 8, 64  # 8 simulated clients/round x 64 images
+    cfg = FedConfig(
+        mode="sketch", error_type="virtual", local_momentum=0.0,
+        virtual_momentum=0.9, weight_decay=5e-4,
+        num_workers=W, local_batch_size=B,
+        k=50_000, num_rows=5, num_cols=500_000, num_blocks=20,
+        num_clients=100, track_bytes=False,
+    )
+
+    model = models.ResNet9(num_classes=10)
+    x0 = jnp.ones((1, 32, 32, 3), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x0)
+    loss_fn = make_cv_loss(model, "bfloat16")
+
+    runtime = FedRuntime(cfg, params, loss_fn, num_clients=cfg.num_clients)
+    state = runtime.init_state()
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "image": jnp.asarray(rng.randn(W, B, 32, 32, 3), jnp.float32),
+        "target": jnp.asarray(rng.randint(0, 10, (W, B)), jnp.int32),
+    }
+    mask = jnp.ones((W, B), bool)
+    client_ids = jnp.arange(W, dtype=jnp.int32)
+    lr = 0.1
+
+    log("compiling + warmup...")
+    t0 = time.time()
+    for _ in range(2):
+        state, metrics = runtime.round(state, client_ids, batch, mask, lr)
+    jax.block_until_ready(state.ps_weights)
+    log(f"warmup done in {time.time() - t0:.1f}s")
+
+    n_rounds = 10
+    t0 = time.time()
+    for _ in range(n_rounds):
+        state, metrics = runtime.round(state, client_ids, batch, mask, lr)
+    jax.block_until_ready(state.ps_weights)
+    dt = time.time() - t0
+
+    images = n_rounds * W * B
+    ips = images / dt
+    log(f"{n_rounds} rounds in {dt:.3f}s -> {ips:.1f} img/s")
+    loss = float(np.asarray(metrics["results"][0]).mean())
+    log(f"final mean client loss {loss:.4f}")
+
+    print(json.dumps({
+        "metric": "cifar10_sketch_round_throughput",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / NOMINAL_SINGLE_GPU_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -228,14 +228,20 @@ def make_fedavg_client(
             w, step_idx, res_acc = carry
             c_batch, c_mask, c_rng = inp
             g, results, n_valid = fwd(w, c_batch, c_mask, c_rng)
+            # fully-padded chunks (mask all zero) must be no-ops: no SGD
+            # step (g would still carry the weight-decay term), no decay
+            # advance, no metric contribution — the reference only ever
+            # iterates real minibatches (fed_worker.py:68-77)
+            valid = (n_valid > 0).astype(jnp.float32)
             # g is the (possibly multi-microbatch) mean-gradient sum; the
             # reference divides the transmitted sum back by the chunk size
             # before stepping (fed_worker.py:96-100) — our fwd already
             # returns the per-chunk mean accumulation, so apply it directly.
             decay = cfg.fedavg_lr_decay ** step_idx
-            w = w - g * lr * decay
-            res_acc = jax.tree.map(lambda a, r: a + r, res_acc, tuple(results))
-            return (w, step_idx + 1.0, res_acc), None
+            w = w - g * (lr * decay * valid)
+            res_acc = jax.tree.map(lambda a, r: a + r * n_valid,
+                                   res_acc, tuple(results))
+            return (w, step_idx + valid, res_acc), None
 
         def epoch_body(carry, epoch_rngs):
             # inner scan closes over the one resident copy of the chunks
@@ -248,7 +254,9 @@ def make_fedavg_client(
         (w_final, _, res_acc), _ = lax.scan(
             epoch_body, (params_vec, 0.0, res_zero), rngs)
 
-        results = tuple(r / n_steps for r in res_acc)
+        # datum-weighted means over the client's real data
+        total = jnp.maximum(n_c * cfg.num_fedavg_epochs, 1.0)
+        results = tuple(r / total for r in res_acc)
         # dataset-size weighting (reference fed_worker.py:104-108)
         transmit = (params_vec - w_final) * n_c
         return ClientOut(transmit, None, None, results, n_c)
