@@ -1,0 +1,90 @@
+"""Differential privacy (clip + noise) and top-k download compression.
+
+Reference behavior pinned: DP worker mode clips each client gradient to
+l2_norm_clip and adds sqrt(num_workers)-scaled gaussian noise
+(fed_worker.py:304-309); DP server mode adds noise once to the aggregated
+update (fed_aggregator.py:497-509); --topk_down keeps stale per-client
+weights that advance by the top-k of their lag (fed_worker.py:232-247).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.core import FedRuntime
+from tests.test_parallel import make_batch, make_cfg, quad_loss
+
+
+def make_rt(**kw):
+    params = {"w": jnp.asarray(
+        np.random.RandomState(0).randn(6, 3), jnp.float32)}
+    cfg = make_cfg(**kw)
+    return FedRuntime(cfg, params, quad_loss, num_clients=16)
+
+
+def test_dp_clip_bounds_update():
+    """With noise 0, DP reduces to per-client L2 clipping: the aggregated
+    gradient norm is bounded by num_workers * clip / total_datums."""
+    clip = 0.01
+    rt = make_rt(mode="uncompressed", do_dp=True, dp_mode="worker",
+                 l2_norm_clip=clip, noise_multiplier=0.0,
+                 virtual_momentum=0.0, track_bytes=False)
+    batch, mask, cids = make_batch(1)
+    s = rt.init_state()
+    w0 = np.asarray(s.ps_weights)
+    s, _ = rt.round(s, cids, batch, mask, 1.0)
+    total = float(np.asarray(mask).sum())
+    bound = 8 * clip * np.asarray(mask.sum(1)).max() / total + 1e-6
+    assert np.linalg.norm(np.asarray(s.ps_weights) - w0) <= bound
+
+
+def test_dp_worker_noise_changes_update_deterministically():
+    kw = dict(mode="uncompressed", do_dp=True, dp_mode="worker",
+              l2_norm_clip=1.0, virtual_momentum=0.0, track_bytes=False)
+    batch, mask, cids = make_batch(1)
+
+    rt0 = make_rt(noise_multiplier=0.0, **kw)
+    s0, _ = rt0.round(rt0.init_state(), cids, batch, mask, 0.1)
+    rt1 = make_rt(noise_multiplier=0.5, **kw)
+    s1, _ = rt1.round(rt1.init_state(), cids, batch, mask, 0.1)
+    s1b, _ = rt1.round(rt1.init_state(), cids, batch, mask, 0.1)
+
+    assert np.abs(np.asarray(s1.ps_weights)
+                  - np.asarray(s0.ps_weights)).max() > 1e-6
+    # same seed => same noise (JAX PRNG determinism; the reference relies
+    # on cuDNN determinism flags instead, cv_train.py:325-326)
+    np.testing.assert_array_equal(np.asarray(s1.ps_weights),
+                                  np.asarray(s1b.ps_weights))
+
+
+def test_dp_server_noise():
+    kw = dict(mode="uncompressed", do_dp=True, dp_mode="server",
+              l2_norm_clip=1e9, virtual_momentum=0.0, track_bytes=False)
+    batch, mask, cids = make_batch(1)
+    rt0 = make_rt(noise_multiplier=0.0, **kw)
+    rt1 = make_rt(noise_multiplier=1.0, **kw)
+    s0, _ = rt0.round(rt0.init_state(), cids, batch, mask, 0.1)
+    s1, _ = rt1.round(rt1.init_state(), cids, batch, mask, 0.1)
+    assert np.abs(np.asarray(s1.ps_weights)
+                  - np.asarray(s0.ps_weights)).max() > 1e-6
+
+
+def test_topk_down_client_weights_lag():
+    rt = make_rt(mode="true_topk", error_type="virtual", k=4,
+                 do_topk_down=True, virtual_momentum=0.0, track_bytes=False)
+    batch, mask, cids = make_batch(2)
+    s = rt.init_state()
+    assert s.client_weights is not None
+    w_init = np.asarray(s.client_weights).copy()
+    for _ in range(2):
+        s, _ = rt.round(s, cids, batch, mask, 0.1)
+    cw = np.asarray(s.client_weights)
+    participating = np.asarray(cids)
+    others = [c for c in range(16) if c not in set(participating.tolist())]
+    # participating clients' stale weights moved; others untouched
+    assert np.abs(cw[participating] - w_init[participating]).max() > 0
+    np.testing.assert_array_equal(cw[others], w_init[others])
+    # each participant's weights differ from PS weights only at <= d coords
+    # moved by top-k increments (k per round => at most 2k coords changed)
+    changed = (np.abs(cw[participating] - w_init[participating]) > 0)
+    assert changed.sum(axis=1).max() <= 2 * rt.cfg.k
