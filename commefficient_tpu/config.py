@@ -126,6 +126,13 @@ class FedConfig:
     max_client_batch: int = 512
     sketch_seed: int = 42
 
+    # profiling: write a jax profiler trace (tensorboard-viewable) of the
+    # first few training rounds to this directory (the reference's analogue
+    # is its cProfile hooks, fed_aggregator.py:46-52)
+    profile_dir: str = ""
+    # rematerialize transformer blocks on backward (memory/FLOPs trade)
+    do_remat: bool = False
+
     # filled in at model-build time, like the reference's args.grad_size
     # (fed_aggregator.py:88). Frozen dataclass => use `replace`.
     grad_size: int = 0
@@ -258,6 +265,8 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--param_dtype", type=str, default="float32")
     p.add_argument("--max_client_batch", type=int, default=512)
     p.add_argument("--sketch_seed", type=int, default=42)
+    p.add_argument("--profile_dir", type=str, default="")
+    p.add_argument("--remat", action="store_true", dest="do_remat")
     return parser
 
 

@@ -188,8 +188,16 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                       else lr * lr_mult)
             batch = train_ds.gather(rnd.idx)
             batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            # profiler window: steady-state rounds 2-4 of the run
+            # (reference analogue: profile_helper, fed_aggregator.py:46-52)
+            if cfg.profile_dir and global_round == 2:
+                jax.profiler.start_trace(cfg.profile_dir)
             state, metrics = runtime.round(
                 state, rnd.client_ids, batch, rnd.mask, lr_arr)
+            if cfg.profile_dir and global_round == 4:
+                jax.block_until_ready(state.ps_weights)
+                jax.profiler.stop_trace()
+                print(f"profiler trace written to {cfg.profile_dir}")
             losses = np.asarray(metrics["results"][0])
             if np.any(np.isnan(losses)):
                 print(f"LOSS OF {losses.mean()} IS NAN, TERMINATING TRAINING")

@@ -43,7 +43,8 @@ def build_gpt2(cfg: FedConfig, tokenizer):
         gcfg = GPT2Config.small(vocab_size=n_vocab - 5)
     else:
         gcfg = GPT2Config(vocab_size=n_vocab - 5,
-                          compute_dtype=jnp.dtype(cfg.compute_dtype))
+                          compute_dtype=jnp.dtype(cfg.compute_dtype),
+                          remat=cfg.do_remat)
     return GPT2DoubleHeads(gcfg), gcfg
 
 

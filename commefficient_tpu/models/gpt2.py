@@ -39,6 +39,9 @@ class GPT2Config:
     num_added_tokens: int = NUM_SPECIAL_TOKENS
     layer_norm_eps: float = 1e-5
     compute_dtype: Any = jnp.bfloat16
+    # rematerialize each block on the backward pass (jax.checkpoint):
+    # trades recompute FLOPs for HBM — the standard long-context memory move
+    remat: bool = False
 
     @property
     def total_vocab(self) -> int:
@@ -111,8 +114,9 @@ class GPT2Backbone(nn.Module):
         if token_type_ids is not None:
             x = x + wte[token_type_ids]
         x = x.astype(cfg.compute_dtype)
+        block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.n_layer):
-            x = Block(cfg, self.attn_impl, name=f"h{i}")(x)
+            x = block_cls(cfg, self.attn_impl, name=f"h{i}")(x)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          name="ln_f")(x)
         return x, wte
