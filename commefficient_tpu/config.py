@@ -126,6 +126,9 @@ class FedConfig:
     max_client_batch: int = 512
     sketch_seed: int = 42
 
+    # TPU-optimized approximate top-k (lax.approx_max_k, 0.95 recall) for
+    # the sparsification selects; exact lax.top_k when False
+    approx_topk: bool = False
     # profiling: write a jax profiler trace (tensorboard-viewable) of the
     # first few training rounds to this directory (the reference's analogue
     # is its cProfile hooks, fed_aggregator.py:46-52)
@@ -265,6 +268,7 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--param_dtype", type=str, default="float32")
     p.add_argument("--max_client_batch", type=int, default=512)
     p.add_argument("--sketch_seed", type=int, default=42)
+    p.add_argument("--approx_topk", action="store_true")
     p.add_argument("--profile_dir", type=str, default="")
     p.add_argument("--remat", action="store_true", dest="do_remat")
     return parser

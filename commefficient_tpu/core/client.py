@@ -171,7 +171,7 @@ def make_client_step(
             to_transmit = base
 
         if cfg.mode == "local_topk":
-            to_transmit = topk(to_transmit, k=cfg.k)
+            to_transmit = topk(to_transmit, k=cfg.k, approx=cfg.approx_topk)
             nz = to_transmit != 0
             if new_error is not None:
                 new_error = jnp.where(nz, 0.0, new_error)   # error feedback
@@ -282,4 +282,4 @@ def topk_down_weights(cfg: FedConfig, ps_weights: jax.Array,
     """Download-compression emulation (reference fed_worker.py:232-247):
     the client's stale weights advance by the top-k of its lag."""
     diff = ps_weights - worker_weights
-    return worker_weights + topk(diff, k=cfg.k)
+    return worker_weights + topk(diff, k=cfg.k, approx=cfg.approx_topk)

@@ -113,7 +113,7 @@ def server_update(
         # reference fed_aggregator.py:511-542
         Vvel = gradient + rho * Vvelocity
         Verr = Verror + Vvel
-        update = topk(Verr, k=cfg.k)
+        update = topk(Verr, k=cfg.k, approx=cfg.approx_topk)
         mask = update != 0
         # error feedback + momentum factor masking at the update support
         Verr = jnp.where(mask, 0.0, Verr)
@@ -133,7 +133,7 @@ def server_update(
         assert cs is not None
         Vvel = gradient + rho * Vvelocity
         Verr = Verror + Vvel  # virtual error (the only legal type, see above)
-        update = sketch_unsketch(cs, Verr, k=cfg.k)
+        update = sketch_unsketch(cs, Verr, k=cfg.k, approx=cfg.approx_topk)
         # re-sketch the dense update to find which table cells it occupies
         # (reference fed_aggregator.py:593-595)
         sketched_update = sketch_encode(cs, update)

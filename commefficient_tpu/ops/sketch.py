@@ -154,10 +154,13 @@ def sketch_decode(cs: CountSketch, table: jax.Array) -> jax.Array:
     return ests.reshape(-1)[: cs.d]
 
 
-def sketch_unsketch(cs: CountSketch, table: jax.Array, k: int) -> jax.Array:
+def sketch_unsketch(cs: CountSketch, table: jax.Array, k: int,
+                    approx: bool = False) -> jax.Array:
     """Top-k heavy-hitter recovery: dense (d,) vector, nonzero only at the k
-    coordinates with the largest estimated magnitude (= ``CSVec.unSketch(k)``)."""
-    return topk(sketch_decode(cs, table), k)
+    coordinates with the largest estimated magnitude (= ``CSVec.unSketch(k)``).
+    ``approx`` uses the TPU approximate top-k (sketch estimates are already
+    approximate, so the compounded error is benign)."""
+    return topk(sketch_decode(cs, table), k, approx=approx)
 
 
 def sketch_l2estimate(cs: CountSketch, table: jax.Array) -> jax.Array:

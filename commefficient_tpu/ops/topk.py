@@ -19,21 +19,30 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _topk_1d(vec: jax.Array, k: int) -> jax.Array:
-    _, idx = lax.top_k(vec * vec, k)
+def _topk_1d(vec: jax.Array, k: int, approx: bool = False) -> jax.Array:
+    if approx:
+        # TPU-native approximate top-k (Chern et al. bucketed reduction):
+        # ~10x faster than exact sort-based top_k on multi-million-element
+        # vectors at 0.95 recall — well-suited to top-k *sparsification*,
+        # which is itself an approximation (a near-top coordinate surviving
+        # one more round in the error accumulator is benign)
+        _, idx = lax.approx_max_k(vec * vec, k, recall_target=0.95)
+    else:
+        _, idx = lax.top_k(vec * vec, k)
     return jnp.zeros_like(vec).at[idx].set(vec[idx])
 
 
-def topk(vec: jax.Array, k: int) -> jax.Array:
+def topk(vec: jax.Array, k: int, approx: bool = False) -> jax.Array:
     """Dense vector keeping only the k largest-magnitude entries.
 
     1-D: top-k over the whole vector. 2-D: row-wise top-k (each row keeps its
-    own k entries), matching reference utils.py:249-252.
+    own k entries), matching reference utils.py:249-252. ``approx`` selects
+    the TPU-optimized approximate kernel (see _topk_1d).
     """
     if vec.ndim == 1:
-        return _topk_1d(vec, k)
+        return _topk_1d(vec, k, approx)
     if vec.ndim == 2:
-        return jax.vmap(lambda row: _topk_1d(row, k))(vec)
+        return jax.vmap(lambda row: _topk_1d(row, k, approx))(vec)
     raise ValueError(f"topk supports 1-D/2-D, got shape {vec.shape}")
 
 
