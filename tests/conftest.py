@@ -20,6 +20,13 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# The env var alone is NOT enough: an axon/TPU sitecustomize may have run
+# ``jax.config.update("jax_platforms", "axon,cpu")`` at interpreter start,
+# which overrides JAX_PLATFORMS and makes the first ``jax.devices()`` block
+# on the TPU tunnel. Re-assert CPU at the config layer (backends are not
+# initialized yet, so this wins).
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

@@ -47,6 +47,27 @@ class TestTopk:
         out = jax.jit(lambda v: topk(v, 3))(vec)
         assert int((out != 0).sum()) == 3
 
+    def test_approx_path(self):
+        """Pin the --approx_topk plumbing (jit, vmap, 1-D and row-wise 2-D).
+        approx_max_k has 0.95 default recall, so compare support overlap
+        rather than exact equality."""
+        rng = np.random.RandomState(10)
+        vec = jnp.asarray(rng.randn(4096).astype(np.float32))
+        k = 64
+        out = jax.jit(lambda v: topk(v, k, approx=True))(vec)
+        assert int((np.asarray(out) != 0).sum()) == k
+        exact_support = set(np.nonzero(np.asarray(topk(vec, k)))[0])
+        approx_support = set(np.nonzero(np.asarray(out))[0])
+        assert len(exact_support & approx_support) >= int(0.9 * k)
+        # values at recovered coords are the originals
+        idx = sorted(approx_support)
+        np.testing.assert_allclose(np.asarray(out)[idx],
+                                   np.asarray(vec)[idx])
+        mats = jnp.asarray(rng.randn(3, 2048).astype(np.float32))
+        out2 = jax.jit(jax.vmap(lambda v: topk(v, 16, approx=True)))(mats)
+        assert out2.shape == mats.shape
+        assert all(int((r != 0).sum()) == 16 for r in np.asarray(out2))
+
 
 class TestClip:
     def test_noop_below_threshold(self):
