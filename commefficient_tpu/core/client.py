@@ -54,6 +54,7 @@ def make_forward_grad(
     unravel: Callable[[jax.Array], Any],
     batch_size: int,
     cs: Optional[CountSketch] = None,
+    defer_encode: bool = False,
 ):
     """Build the microbatched forward/backward (reference fed_worker.py:249-335).
 
@@ -124,8 +125,12 @@ def make_forward_grad(
                     1.0 * cfg.num_workers) * jax.random.normal(
                         rng, g.shape, g.dtype)
                 g = g + noise
-        # mode compression (reference fed_worker.py:312-333)
-        if cfg.mode == "sketch":
+        # mode compression (reference fed_worker.py:312-333). When
+        # ``defer_encode`` the runtime exploits sketch linearity
+        # (sum-of-sketches == sketch-of-sum) to encode ONCE after the
+        # cross-client sum instead of once per client — legal whenever no
+        # per-client nonlinearity acts on the table (no table clip).
+        if cfg.mode == "sketch" and not defer_encode:
             table = sketch_encode(cs, g)
             if cfg.max_grad_norm is not None:
                 table = clip_by_l2_norm(table, cfg.max_grad_norm)
@@ -141,6 +146,7 @@ def make_client_step(
     unravel: Callable[[jax.Array], Any],
     batch_size: int,
     cs: Optional[CountSketch] = None,
+    defer_encode: bool = False,
 ):
     """Single-round client step: forward_grad + local momentum / error /
     local-topk pipeline (reference fed_worker.py:184-230).
@@ -149,7 +155,8 @@ def make_client_step(
     ``velocity``/``error`` are this client's persistent rows (or None when the
     mode doesn't allocate them, reference fed_aggregator.py:105-129).
     """
-    fwd = make_forward_grad(cfg, loss_fn, unravel, batch_size, cs)
+    fwd = make_forward_grad(cfg, loss_fn, unravel, batch_size, cs,
+                            defer_encode=defer_encode)
 
     def step(params_vec, batch, mask, velocity, error, rng) -> ClientOut:
         g, results, n_valid = fwd(params_vec, batch, mask, rng)

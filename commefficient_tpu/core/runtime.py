@@ -34,7 +34,7 @@ from commefficient_tpu.core import client as client_lib
 from commefficient_tpu.core.server import server_update, validate_mode_combo
 from commefficient_tpu.core.state import FedState
 from commefficient_tpu.ops import ravel_params
-from commefficient_tpu.ops.sketch import make_sketch
+from commefficient_tpu.ops.sketch import make_sketch, sketch_encode
 
 
 class FedRuntime:
@@ -81,6 +81,17 @@ class FedRuntime:
         if cfg.mode == "sketch":
             self.cs = make_sketch(cfg.grad_size, cfg.num_cols, cfg.num_rows,
                                   cfg.num_blocks, seed=cfg.sketch_seed)
+        # Sketch linearity: sum-of-client-sketches == sketch-of-summed-grads,
+        # so the O(d·r) encode can run once per round instead of once per
+        # client — unless a per-client nonlinearity (table clip) intervenes.
+        # (The reference necessarily encodes per worker because aggregation
+        # happens across processes via NCCL, fed_worker.py:312-320.)
+        # Single-device only: on a mesh the cross-client sum of dense (d,)
+        # transmits would move d floats over ICI where pre-encoded (r, c)
+        # tables move r*c — the per-shard encode there plays the NCCL role.
+        self._defer_encode = (cfg.mode == "sketch"
+                              and cfg.max_grad_norm is None
+                              and mesh is None)
 
         loss_fn_val = loss_fn_val if loss_fn_val is not None else loss_fn_train
         if cfg.mode == "fedavg":
@@ -88,7 +99,8 @@ class FedRuntime:
                 cfg, loss_fn_train, unravel, self.batch_size, self.cs)
         else:
             self._client_fn = client_lib.make_client_step(
-                cfg, loss_fn_train, unravel, self.batch_size, self.cs)
+                cfg, loss_fn_train, unravel, self.batch_size, self.cs,
+                defer_encode=self._defer_encode)
         self._val_fn_inner = client_lib.make_val_step(cfg, loss_fn_val, unravel)
 
         if self.shardings is not None:
@@ -218,6 +230,9 @@ class FedRuntime:
         # (reference fed_worker.py:131,138 + fed_aggregator.py:329-332)
         total = jnp.maximum(out.n_valid.sum(), 1.0)
         agg = out.transmit.sum(axis=0) / total
+        if self._defer_encode:
+            from commefficient_tpu.ops.sketch import sketch_encode
+            agg = sketch_encode(self.cs, agg)
 
         # ---- server update
         server_lr = jnp.asarray(1.0) if cfg.mode == "fedavg" else lr

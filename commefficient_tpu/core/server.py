@@ -33,7 +33,8 @@ import jax.numpy as jnp
 
 from commefficient_tpu.config import FedConfig
 from commefficient_tpu.ops import topk
-from commefficient_tpu.ops.sketch import CountSketch, sketch_encode, sketch_unsketch
+from commefficient_tpu.ops.sketch import (CountSketch, sketch_encode_at,
+                                          sketch_unsketch_with_idx)
 
 
 def validate_mode_combo(cfg: FedConfig) -> None:
@@ -133,10 +134,12 @@ def server_update(
         assert cs is not None
         Vvel = gradient + rho * Vvelocity
         Verr = Verror + Vvel  # virtual error (the only legal type, see above)
-        update = sketch_unsketch(cs, Verr, k=cfg.k, approx=cfg.approx_topk)
-        # re-sketch the dense update to find which table cells it occupies
-        # (reference fed_aggregator.py:593-595)
-        sketched_update = sketch_encode(cs, update)
+        update, upd_idx = sketch_unsketch_with_idx(
+            cs, Verr, k=cfg.k, approx=cfg.approx_topk)
+        # re-sketch the update to find which table cells it occupies
+        # (reference fed_aggregator.py:593-595) — the update is k-sparse, so
+        # the sparse encode is exact at O(k·r) instead of O(d·r)
+        sketched_update = sketch_encode_at(cs, update, upd_idx)
         mask = sketched_update != 0
         Vvel = jnp.where(mask, 0.0, Vvel)
         Verr = jnp.where(mask, 0.0, Verr)

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from commefficient_tpu.ops.topk import topk
+from commefficient_tpu.ops.topk import topk, topk_with_idx
 
 _U32 = jnp.uint32
 
@@ -161,6 +161,27 @@ def sketch_unsketch(cs: CountSketch, table: jax.Array, k: int,
     ``approx`` uses the TPU approximate top-k (sketch estimates are already
     approximate, so the compounded error is benign)."""
     return topk(sketch_decode(cs, table), k, approx=approx)
+
+
+def sketch_unsketch_with_idx(cs: CountSketch, table: jax.Array, k: int,
+                             approx: bool = False):
+    """`sketch_unsketch` that also returns the (k,) support indices, so the
+    caller can re-sketch the k-sparse update with `sketch_encode_at` instead
+    of a full d-coordinate encode (the reference re-sketches the dense update,
+    fed_aggregator.py:593-595 — O(d) work for a k-sparse vector)."""
+    return topk_with_idx(sketch_decode(cs, table), k, approx=approx)
+
+
+def sketch_encode_at(cs: CountSketch, vec: jax.Array,
+                     idx: jax.Array) -> jax.Array:
+    """Encode a k-sparse vector given its support indices: exactly equals
+    ``sketch_encode(cs, vec)`` when ``vec`` is zero outside ``idx``, but costs
+    O(k·r) scatter updates instead of O(d·r)."""
+    buckets, signs = _buckets_signs(cs, idx.astype(_U32))
+    vals = signs * vec[idx][None, :]
+    return jax.vmap(
+        lambda b, v: jax.ops.segment_sum(v, b, num_segments=cs.c)
+    )(buckets, vals)
 
 
 def sketch_l2estimate(cs: CountSketch, table: jax.Array) -> jax.Array:

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _topk_1d(vec: jax.Array, k: int, approx: bool = False) -> jax.Array:
+def topk_with_idx(vec: jax.Array, k: int, approx: bool = False):
+    """Like ``topk`` (1-D) but also returns the (k,) support indices."""
     if approx:
         # TPU-native approximate top-k (Chern et al. bucketed reduction):
         # ~10x faster than exact sort-based top_k on multi-million-element
@@ -29,7 +30,11 @@ def _topk_1d(vec: jax.Array, k: int, approx: bool = False) -> jax.Array:
         _, idx = lax.approx_max_k(vec * vec, k, recall_target=0.95)
     else:
         _, idx = lax.top_k(vec * vec, k)
-    return jnp.zeros_like(vec).at[idx].set(vec[idx])
+    return jnp.zeros_like(vec).at[idx].set(vec[idx]), idx
+
+
+def _topk_1d(vec: jax.Array, k: int, approx: bool = False) -> jax.Array:
+    return topk_with_idx(vec, k, approx)[0]
 
 
 def topk(vec: jax.Array, k: int, approx: bool = False) -> jax.Array:
