@@ -29,7 +29,6 @@ from jax import lax
 
 from commefficient_tpu.config import FedConfig
 from commefficient_tpu.ops import clip_by_l2_norm, topk
-from commefficient_tpu.ops.sketch import CountSketch, sketch_encode
 
 
 class ClientOut(NamedTuple):
@@ -53,7 +52,7 @@ def make_forward_grad(
     loss_fn: Callable,
     unravel: Callable[[jax.Array], Any],
     batch_size: int,
-    cs: Optional[CountSketch] = None,
+    cs: Optional[Any] = None,
     defer_encode: bool = False,
 ):
     """Build the microbatched forward/backward (reference fed_worker.py:249-335).
@@ -68,7 +67,7 @@ def make_forward_grad(
     num_iters, mb = _num_microbatches(cfg, batch_size)
     pad_to = num_iters * mb
     if cfg.mode == "sketch":
-        assert cs is not None, "sketch mode requires the runtime's CountSketch"
+        assert cs is not None, "sketch mode requires the runtime's sketch"
 
     def loss_on_vec(vec, mb_batch, mb_mask):
         loss, metrics = loss_fn(unravel(vec), mb_batch, mb_mask)
@@ -131,9 +130,9 @@ def make_forward_grad(
         # cross-client sum instead of once per client — legal whenever no
         # per-client nonlinearity acts on the table (no table clip).
         if cfg.mode == "sketch" and not defer_encode:
-            table = sketch_encode(cs, g)
+            table = cs.encode(g)
             if cfg.max_grad_norm is not None:
-                table = clip_by_l2_norm(table, cfg.max_grad_norm)
+                table = cs.clip(table, cfg.max_grad_norm)
             g = table
         return g, results, n_valid
 
@@ -145,7 +144,7 @@ def make_client_step(
     loss_fn: Callable,
     unravel: Callable[[jax.Array], Any],
     batch_size: int,
-    cs: Optional[CountSketch] = None,
+    cs: Optional[Any] = None,
     defer_encode: bool = False,
 ):
     """Single-round client step: forward_grad + local momentum / error /
@@ -195,7 +194,7 @@ def make_fedavg_client(
     loss_fn: Callable,
     unravel: Callable[[jax.Array], Any],
     batch_size: int,
-    cs: Optional[CountSketch] = None,
+    cs: Optional[Any] = None,
 ):
     """FedAvg local-SGD loop (reference fed_worker.py:61-113).
 

@@ -34,7 +34,7 @@ from commefficient_tpu.core import client as client_lib
 from commefficient_tpu.core.server import server_update, validate_mode_combo
 from commefficient_tpu.core.state import FedState
 from commefficient_tpu.ops import ravel_params
-from commefficient_tpu.ops.sketch import make_sketch, sketch_encode
+from commefficient_tpu.ops.sketch import make_sketch_impl
 
 
 class FedRuntime:
@@ -79,8 +79,9 @@ class FedRuntime:
                            else cfg.max_client_batch)
         self.cs = None
         if cfg.mode == "sketch":
-            self.cs = make_sketch(cfg.grad_size, cfg.num_cols, cfg.num_rows,
-                                  cfg.num_blocks, seed=cfg.sketch_seed)
+            self.cs = make_sketch_impl(
+                cfg.sketch_impl, cfg.grad_size, cfg.num_cols, cfg.num_rows,
+                cfg.num_blocks, seed=cfg.sketch_seed)
         # Sketch linearity: sum-of-client-sketches == sketch-of-summed-grads,
         # so the O(d·r) encode can run once per round instead of once per
         # client — unless a per-client nonlinearity (table clip) intervenes.
@@ -92,6 +93,17 @@ class FedRuntime:
         self._defer_encode = (cfg.mode == "sketch"
                               and cfg.max_grad_norm is None
                               and mesh is None)
+        # With deferred encode AND the SRHT subtractive server rule, every
+        # table the server ever holds is enc(<some dense vector>) — encode is
+        # linear and the rule only ever adds/subtracts encodes. So the
+        # momentum/error state can live as dense (d,) PRE-IMAGES: the
+        # enc(update)/enc(masked-velocity) subtractions become free dense
+        # subtractions and the whole server pass needs exactly one batched
+        # encode+decode round-trip (which is where FetchSGD's compression
+        # noise enters). Bit-identical (up to fp reassociation) to the
+        # table-space rule; see core/server.py dense_preimage branch.
+        self._dense_preimage = (self._defer_encode
+                                and getattr(self.cs, "dense_transform", False))
 
         loss_fn_val = loss_fn_val if loss_fn_val is not None else loss_fn_train
         if cfg.mode == "fedavg":
@@ -137,7 +149,10 @@ class FedRuntime:
 
     def _make_state(self, seed) -> FedState:
         cfg = self.cfg
-        tx = cfg.transmitted_shape
+        # dense pre-image states for the single-device SRHT path (see
+        # __init__); sketch-table shape otherwise
+        tx = ((cfg.grad_size,) if self._dense_preimage
+              else cfg.transmitted_shape)
         d = cfg.grad_size
         n = self.num_clients
         zeros_tx = jnp.zeros(tx, jnp.float32)
@@ -230,15 +245,15 @@ class FedRuntime:
         # (reference fed_worker.py:131,138 + fed_aggregator.py:329-332)
         total = jnp.maximum(out.n_valid.sum(), 1.0)
         agg = out.transmit.sum(axis=0) / total
-        if self._defer_encode:
-            from commefficient_tpu.ops.sketch import sketch_encode
-            agg = sketch_encode(self.cs, agg)
+        if self._defer_encode and not self._dense_preimage:
+            agg = self.cs.encode(agg)
 
         # ---- server update
         server_lr = jnp.asarray(1.0) if cfg.mode == "fedavg" else lr
         update, Vvel, Verr, sup_mask = server_update(
             cfg, agg, state.Vvelocity, state.Verror, server_lr,
-            cs=self.cs, dp_rng=server_rng)
+            cs=self.cs, dp_rng=server_rng,
+            dense_preimage=self._dense_preimage)
         ps_weights = state.ps_weights - update
 
         # ---- write back per-client rows

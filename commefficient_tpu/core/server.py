@@ -33,8 +33,7 @@ import jax.numpy as jnp
 
 from commefficient_tpu.config import FedConfig
 from commefficient_tpu.ops import topk
-from commefficient_tpu.ops.sketch import (CountSketch, sketch_encode_at,
-                                          sketch_unsketch_with_idx)
+from commefficient_tpu.ops.topk import topk_with_idx
 
 
 def validate_mode_combo(cfg: FedConfig) -> None:
@@ -83,8 +82,9 @@ def server_update(
     Vvelocity: jax.Array,
     Verror: jax.Array,
     lr: jax.Array,
-    cs: Optional[CountSketch] = None,
+    cs=None,
     dp_rng: Optional[jax.Array] = None,
+    dense_preimage: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, Optional[jax.Array]]:
     """Dispatch to the mode's update rule (reference fed_aggregator.py:469-481).
 
@@ -132,14 +132,50 @@ def server_update(
         # in (r, c) sketch-table space; tables are linear so the psum'd
         # worker tables equal the sketch of the summed gradient.
         assert cs is not None
+        if dense_preimage:
+            # Single-device SRHT fast path (runtime._dense_preimage):
+            # momentum/error live as dense (d,) pre-images whose encodes are
+            # the tables of the rule below — linearity makes the trajectories
+            # identical while the feedback subtractions become dense ops.
+            # ``gradient`` arrives dense (deferred encode skipped entirely);
+            # one batched enc+dec round-trip injects the sketch noise.
+            Vvel = gradient + rho * Vvelocity
+            Verr = Verror + Vvel
+            # natively batched (B=2) enc+dec — batch folds into the
+            # transform's row axis; vmap here would break the sketch's fused
+            # selection patterns
+            ests_err, ests_vel = cs.decode(cs.encode(jnp.stack([Verr, Vvel])))
+            update, upd_idx = topk_with_idx(ests_err, k=cfg.k,
+                                            approx=cfg.approx_topk)
+            Verr = Verr - update                       # error feedback
+            Vvel = Vvel.at[upd_idx].add(-ests_vel[upd_idx])  # momentum mask
+            return update * lr, Vvel, Verr, None
         Vvel = gradient + rho * Vvelocity
         Verr = Verror + Vvel  # virtual error (the only legal type, see above)
-        update, upd_idx = sketch_unsketch_with_idx(
-            cs, Verr, k=cfg.k, approx=cfg.approx_topk)
+        if getattr(cs, "dense_transform", False):
+            # SRHT sketch (ops/rht.py): the transform of a k-sparse update is
+            # dense, so "zero the occupied cells" (reference
+            # fed_aggregator.py:596-611) would wipe the whole table. The
+            # equivalent rule in estimate space: subtract the sketch of the
+            # quantity the reference zeroes — the update itself for Verror,
+            # and the velocity's estimated values at the update support for
+            # Vvelocity (momentum factor masking). In the lossless limit
+            # (c >= d', exact decode) this is bit-for-bit the reference rule.
+            ests_err, ests_vel = cs.decode(jnp.stack([Verr, Vvel]))
+            update, upd_idx = topk_with_idx(ests_err, k=cfg.k,
+                                            approx=cfg.approx_topk)
+            vel_at_support = jnp.zeros_like(ests_vel).at[upd_idx].set(
+                ests_vel[upd_idx])
+            enc_upd, enc_vel = cs.encode(jnp.stack([update, vel_at_support]))
+            Verr = Verr - enc_upd
+            Vvel = Vvel - enc_vel
+            return update * lr, Vvel, Verr, None
+        update, upd_idx = cs.unsketch_with_idx(
+            Verr, k=cfg.k, approx=cfg.approx_topk)
         # re-sketch the update to find which table cells it occupies
         # (reference fed_aggregator.py:593-595) — the update is k-sparse, so
         # the sparse encode is exact at O(k·r) instead of O(d·r)
-        sketched_update = sketch_encode_at(cs, update, upd_idx)
+        sketched_update = cs.encode_at(update, upd_idx)
         mask = sketched_update != 0
         Vvel = jnp.where(mask, 0.0, Vvel)
         Verr = jnp.where(mask, 0.0, Verr)

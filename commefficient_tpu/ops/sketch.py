@@ -37,7 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from commefficient_tpu.ops.topk import topk, topk_with_idx
+from commefficient_tpu.ops.topk import (clip_by_l2_norm, median_axis0, topk,
+                                        topk_with_idx)
 
 _U32 = jnp.uint32
 
@@ -66,6 +67,11 @@ class CountSketch:
     def tree_unflatten(cls, aux, children):
         return cls(children[0], children[1], *aux)
 
+    # the hash sketch of a k-sparse vector is k·r-sparse in table cells, so
+    # the server's error feedback can zero "occupied cells" exactly as the
+    # reference does (contrast RHTSketch.dense_transform)
+    dense_transform = False
+
     @property
     def block_len(self) -> int:
         return -(-self.d // self.num_blocks)  # ceil
@@ -76,6 +82,33 @@ class CountSketch:
 
     def empty_table(self, dtype=jnp.float32) -> jax.Array:
         return jnp.zeros(self.table_shape, dtype)
+
+    # uniform method API shared with ops.rht.RHTSketch, so the runtime and
+    # server are implementation-agnostic
+    def encode(self, vec: jax.Array) -> jax.Array:
+        return sketch_encode(self, vec)
+
+    def encode_at(self, vec: jax.Array, idx: jax.Array) -> jax.Array:
+        return sketch_encode_at(self, vec, idx)
+
+    def decode(self, table: jax.Array) -> jax.Array:
+        return sketch_decode(self, table)
+
+    def unsketch(self, table: jax.Array, k: int, approx: bool = False):
+        return sketch_unsketch(self, table, k, approx=approx)
+
+    def unsketch_with_idx(self, table: jax.Array, k: int,
+                          approx: bool = False):
+        return sketch_unsketch_with_idx(self, table, k, approx=approx)
+
+    def l2estimate(self, table: jax.Array) -> jax.Array:
+        return sketch_l2estimate(self, table)
+
+    def clip(self, table: jax.Array, clip: float) -> jax.Array:
+        """Scale the table so its estimated vector norm is <= clip; the hash
+        sketch's norm estimate is the median per-row table norm, which is
+        exactly the 2-D branch of clip_by_l2_norm."""
+        return clip_by_l2_norm(table, clip)
 
 
 def make_sketch(d: int, c: int, r: int, num_blocks: int = 1,
@@ -90,6 +123,19 @@ def make_sketch(d: int, c: int, r: int, num_blocks: int = 1,
     sign_keys = rng.randint(0, 2**32, size=(r,), dtype=np.uint64).astype(np.uint32) | 1
     return CountSketch(jnp.asarray(bucket_keys), jnp.asarray(sign_keys),
                        d=d, c=c, r=r, num_blocks=num_blocks)
+
+
+def make_sketch_impl(impl: str, d: int, c: int, r: int, num_blocks: int = 1,
+                     seed: int = 42):
+    """Factory over the two sketch implementations: ``"rht"`` (SRHT, MXU
+    matmuls — the TPU-native default) or ``"hash"`` (count sketch, exact
+    CSVec semantics)."""
+    if impl == "rht":
+        from commefficient_tpu.ops.rht import make_rht_sketch
+        return make_rht_sketch(d, c, r, seed=seed)
+    if impl == "hash":
+        return make_sketch(d, c, r, num_blocks, seed=seed)
+    raise ValueError(f"unknown sketch_impl {impl!r} (want 'rht' or 'hash')")
 
 
 def _mix32(h: jax.Array) -> jax.Array:
@@ -148,7 +194,7 @@ def sketch_decode(cs: CountSketch, table: jax.Array) -> jax.Array:
     def body(_, b_idx):
         buckets, signs = _buckets_signs(cs, base + b_idx * _U32(bl))
         ests = signs * table[rows, buckets]       # (r, bl)
-        return None, jnp.median(ests, axis=0)     # (bl,)
+        return None, median_axis0(ests)           # (bl,)
 
     _, ests = lax.scan(body, None, jnp.arange(nb, dtype=_U32))
     return ests.reshape(-1)[: cs.d]

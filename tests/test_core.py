@@ -127,12 +127,17 @@ class TestGoldenTrajectories:
         for got, want in zip(traj_t, traj_u):
             np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
 
-    def test_sketch_lossless_matches_true_topk(self):
+    @pytest.mark.parametrize("impl", ["hash", "rht"])
+    def test_sketch_lossless_matches_true_topk(self, impl):
         """Huge table => estimates are near-exact => FetchSGD reduces to
-        true top-k (SURVEY.md §4 golden strategy)."""
+        true top-k (SURVEY.md §4 golden strategy). For the rht impl the
+        lossless limit is exact by construction (c == padded size), which
+        also certifies the subtractive error-feedback rule coincides with
+        the reference's cell-masking there (core/server.py)."""
         d = D_FEAT + 1
         cfg_s = base_cfg(mode="sketch", error_type="virtual", k=d,
-                         num_rows=7, num_cols=4096, num_blocks=1)
+                         num_rows=7, num_cols=4096, num_blocks=1,
+                         sketch_impl=impl)
         _, _, traj_s, _ = run_rounds(cfg_s, 5)
         _, _, traj_u, _ = run_rounds(base_cfg(), 5)
         for got, want in zip(traj_s, traj_u):
@@ -163,9 +168,11 @@ class TestErrorFeedback:
         assert (verr == 0).sum() >= 2
         assert (verr != 0).sum() > 0
 
-    def test_loss_decreases(self):
+    @pytest.mark.parametrize("impl", ["hash", "rht"])
+    def test_loss_decreases(self, impl):
         cfg = base_cfg(mode="sketch", error_type="virtual", k=4,
-                       num_rows=5, num_cols=256, num_blocks=1)
+                       num_rows=5, num_cols=256, num_blocks=1,
+                       sketch_impl=impl)
         _, _, _, hist = run_rounds(cfg, 20, lr=0.05)
         first = hist[0]["results"][0].mean()
         last = hist[-1]["results"][0].mean()

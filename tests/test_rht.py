@@ -1,0 +1,102 @@
+"""Property tests for the SRHT sketch (ops/rht.py) — the MXU-native
+alternative to the hash count sketch. Mirrors the CSVec-property suite in
+test_ops.py::TestSketch: linearity (tables must psum correctly), lossless
+exactness, heavy-hitter recovery under compression, norm estimation, and
+table clipping."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.ops.rht import make_rht_sketch
+from commefficient_tpu.ops.sketch import make_sketch_impl
+
+
+class TestRHTSketch:
+    def test_linearity(self):
+        cs = make_rht_sketch(d=1000, c=128, r=3, seed=0)
+        rng = np.random.RandomState(0)
+        a = jnp.asarray(rng.randn(1000), jnp.float32)
+        b = jnp.asarray(rng.randn(1000), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(cs.encode(a) + cs.encode(b)),
+            np.asarray(cs.encode(a + b)), rtol=1e-4, atol=1e-4)
+
+    def test_lossless_roundtrip_exact(self):
+        """c == padded transform size => S is a permutation and decode is the
+        exact inverse (the analogue of a collision-free count sketch)."""
+        d = 700
+        cs = make_rht_sketch(d=d, c=1024, r=3, seed=1)
+        assert cs.dp == 1024
+        v = jnp.asarray(np.random.RandomState(1).randn(d), jnp.float32)
+        est = cs.decode(cs.encode(v))
+        np.testing.assert_allclose(np.asarray(est), np.asarray(v),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_heavy_hitter_recovery(self):
+        """A strongly k-sparse signal's support and values survive 8x
+        compression through the median-of-r estimates."""
+        d, k = 8192, 8
+        cs = make_rht_sketch(d=d, c=1024, r=5, seed=2)
+        rng = np.random.RandomState(2)
+        v = rng.randn(d).astype(np.float32) * 0.1
+        idx = rng.choice(d, k, replace=False)
+        v[idx] = 50.0 * np.sign(rng.randn(k))
+        dense, got_idx = cs.unsketch_with_idx(cs.encode(jnp.asarray(v)), k)
+        assert set(np.asarray(got_idx).tolist()) == set(idx.tolist())
+        np.testing.assert_allclose(np.asarray(dense)[idx], v[idx], rtol=0.2)
+
+    def test_decode_unbiased(self):
+        """Averaged over independent sketches, the estimate of a fixed
+        vector converges to the vector (E[est] = v)."""
+        d = 512
+        v = np.random.RandomState(3).randn(d).astype(np.float32)
+        acc = np.zeros(d, np.float64)
+        n = 30
+        for s in range(n):
+            cs = make_rht_sketch(d=d, c=128, r=1, seed=100 + s)
+            acc += np.asarray(cs.decode(cs.encode(jnp.asarray(v))))
+        err = np.abs(acc / n - v).mean() / np.abs(v).mean()
+        assert err < 0.35, err
+
+    def test_l2estimate(self):
+        d = 4096
+        cs = make_rht_sketch(d=d, c=512, r=5, seed=4)
+        v = jnp.asarray(np.random.RandomState(4).randn(d), jnp.float32)
+        est = float(cs.l2estimate(cs.encode(v)))
+        true = float(jnp.linalg.norm(v))
+        assert abs(est - true) / true < 0.15, (est, true)
+
+    def test_clip_scales_to_threshold(self):
+        d = 4096
+        cs = make_rht_sketch(d=d, c=512, r=5, seed=5)
+        v = jnp.asarray(np.random.RandomState(5).randn(d), jnp.float32) * 10
+        t = cs.encode(v)
+        clipped = cs.clip(t, 1.0)
+        assert float(cs.l2estimate(clipped)) <= 1.0 + 1e-4
+        # under the threshold => untouched
+        np.testing.assert_array_equal(np.asarray(cs.clip(t, 1e9)),
+                                      np.asarray(t))
+
+    def test_factory_dispatch(self):
+        rht = make_sketch_impl("rht", d=100, c=64, r=3)
+        hsh = make_sketch_impl("hash", d=100, c=64, r=3)
+        assert rht.dense_transform and not hsh.dense_transform
+        with pytest.raises(ValueError):
+            make_sketch_impl("nope", d=100, c=64, r=3)
+
+    def test_jit_and_native_batching(self):
+        cs = make_rht_sketch(d=500, c=128, r=3, seed=6)
+        vs = jnp.asarray(np.random.RandomState(6).randn(4, 500), jnp.float32)
+        tables = jax.jit(cs.encode)(vs)
+        assert tables.shape == (4, 3, 128)
+        # batched encode of each == unbatched encode of each
+        np.testing.assert_allclose(np.asarray(tables[0]),
+                                   np.asarray(cs.encode(vs[0])),
+                                   rtol=1e-5, atol=1e-5)
+        ests = jax.jit(cs.decode)(tables)
+        assert ests.shape == (4, 500)
+        np.testing.assert_allclose(np.asarray(ests[2]),
+                                   np.asarray(cs.decode(tables[2])),
+                                   rtol=1e-5, atol=1e-5)
