@@ -75,6 +75,7 @@ class FedRuntime:
             self.num_clients = -(-self.num_clients // n_dev) * n_dev
         else:
             self.shardings = None
+        self._axis = self.shardings.axis if self.shardings else None
         self.batch_size = (cfg.local_batch_size if cfg.local_batch_size > 0
                            else cfg.max_client_batch)
         self.cs = None
@@ -87,12 +88,13 @@ class FedRuntime:
         # client — unless a per-client nonlinearity (table clip) intervenes.
         # (The reference necessarily encodes per worker because aggregation
         # happens across processes via NCCL, fed_worker.py:312-320.)
-        # Single-device only: on a mesh the cross-client sum of dense (d,)
-        # transmits would move d floats over ICI where pre-encoded (r, c)
-        # tables move r*c — the per-shard encode there plays the NCCL role.
+        # On a mesh the deferral is per-SHARD: each device sums its local
+        # clients' dense gradients and encodes once, then the (r, c) tables
+        # psum over ICI — encode work drops from per-client to per-device
+        # and the collective stays table-sized (the TPU analogue of the
+        # reference's encode-before-NCCL-reduce).
         self._defer_encode = (cfg.mode == "sketch"
-                              and cfg.max_grad_norm is None
-                              and mesh is None)
+                              and cfg.max_grad_norm is None)
         # With deferred encode AND the SRHT subtractive server rule, every
         # table the server ever holds is enc(<some dense vector>) — encode is
         # linear and the rule only ever adds/subtracts encodes. So the
@@ -102,7 +104,10 @@ class FedRuntime:
         # encode+decode round-trip (which is where FetchSGD's compression
         # noise enters). Bit-identical (up to fp reassociation) to the
         # table-space rule; see core/server.py dense_preimage branch.
-        self._dense_preimage = (self._defer_encode
+        # Single-device ONLY: on a mesh the pre-image trick would turn the
+        # table-sized psum back into a d-sized dense psum — there the
+        # per-shard encode + table-space subtractive rule applies instead.
+        self._dense_preimage = (self._defer_encode and mesh is None
                                 and getattr(self.cs, "dense_transform", False))
 
         loss_fn_val = loss_fn_val if loss_fn_val is not None else loss_fn_train
@@ -226,27 +231,73 @@ class FedRuntime:
         err_rows = (state.client_errors[client_ids]
                     if state.client_errors is not None else None)
 
-        # ---- client compute, vmapped over the round's client axis
-        if cfg.mode == "fedavg":
-            out = jax.vmap(
-                self._client_fn,
-                in_axes=(params_axis, 0, 0, None, 0))(
-                    used_weights, batch, mask, lr, client_rngs)
-        else:
-            out = jax.vmap(
-                self._client_fn,
-                in_axes=(params_axis, 0, 0,
-                         0 if vel_rows is not None else None,
-                         0 if err_rows is not None else None, 0))(
-                    used_weights, batch, mask, vel_rows, err_rows,
-                    client_rngs)
-
-        # ---- aggregate: sum over clients / total datums
+        # ---- client compute + aggregation
         # (reference fed_worker.py:131,138 + fed_aggregator.py:329-332)
-        total = jnp.maximum(out.n_valid.sum(), 1.0)
-        agg = out.transmit.sum(axis=0) / total
-        if self._defer_encode and not self._dense_preimage:
-            agg = self.cs.encode(agg)
+        # vmapped over the round's client axis; on a mesh the block below is
+        # shard_mapped so each device sums (and, deferred, sketch-encodes)
+        # its local clients before ONE explicit psum over ICI — the direct
+        # analogue of the reference's per-worker compute + NCCL reduce.
+        has_vel = vel_rows is not None
+        has_err = err_rows is not None
+
+        def client_block(used_weights, batch, mask, vel_rows, err_rows,
+                         client_rngs, lr):
+            if cfg.mode == "fedavg":
+                out = jax.vmap(
+                    self._client_fn,
+                    in_axes=(params_axis, 0, 0, None, 0))(
+                        used_weights, batch, mask, lr, client_rngs)
+            else:
+                out = jax.vmap(
+                    self._client_fn,
+                    in_axes=(params_axis, 0, 0,
+                             0 if has_vel else None,
+                             0 if has_err else None, 0))(
+                        used_weights, batch, mask, vel_rows, err_rows,
+                        client_rngs)
+            agg = out.transmit.sum(axis=0)
+            if self._defer_encode and not self._dense_preimage:
+                agg = self.cs.encode(agg)
+            n_total = out.n_valid.sum()
+            if self._axis is not None:
+                agg = lax.psum(agg, self._axis)
+                n_total = lax.psum(n_total, self._axis)
+            return agg, n_total, out.velocity, out.error, out.results, \
+                out.n_valid
+
+        if self._axis is not None:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+            ax = self._axis
+            row = P(ax)
+            in_specs = (
+                row if params_axis == 0 else P(),
+                jax.tree.map(lambda _: row, batch),
+                row,
+                row if has_vel else None,
+                row if has_err else None,
+                row,
+                P(),
+            )
+            out_specs = (
+                P(), P(),
+                row if (cfg.mode != "fedavg" and has_vel) else None,
+                row if (cfg.mode != "fedavg" and has_err) else None,
+                tuple(row for _ in range(cfg.num_results_train)),
+                row,
+            )
+            # check_vma off: the client step's scan carries start as
+            # replicated zeros and become device-varying on the first
+            # iteration, which the strict varying-axis checker rejects
+            client_block = shard_map(client_block, mesh=self.mesh,
+                                     in_specs=in_specs, out_specs=out_specs,
+                                     check_vma=False)
+
+        agg, n_total, vel_new, err_new, results, n_valid = client_block(
+            used_weights, batch, mask, vel_rows, err_rows, client_rngs, lr)
+        out = client_lib.ClientOut(None, vel_new, err_new, results, n_valid)
+        total = jnp.maximum(n_total, 1.0)
+        agg = agg / total
 
         # ---- server update
         server_lr = jnp.asarray(1.0) if cfg.mode == "fedavg" else lr
