@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Secondary benchmark: GPT-2 (124M) sketched federated round throughput
+(BASELINE.md config 4: GPT2-small / PersonaChat-shaped batches, FetchSGD
+sketch 5x500k). Prints ONE JSON line like bench.py; the driver's headline
+metric remains bench.py (CIFAR10 sketch round throughput).
+
+Usage: python bench_gpt2.py  (first compile at this scale takes ~10-20 min
+on the axon remote-compile path; subsequent runs hit the compile cache)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# PersonaChat-lineage throughput anchor: a V100 runs GPT-2-small fwd+bwd at
+# ~4.5k tok/s; the reference publishes no numbers of its own (BASELINE.md)
+NOMINAL_SINGLE_GPU_TOK_PER_SEC = 4500.0
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.core import FedRuntime
+    from commefficient_tpu.losses import make_gpt2_train_loss
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+
+    log("devices:", jax.devices())
+    model = GPT2DoubleHeads(GPT2Config(remat=True))
+    W, B, NC, S = 4, 2, 2, 128
+    rng = np.random.RandomState(0)
+    batch = {
+        "input_ids": jnp.asarray(
+            rng.randint(0, 50257, (W, B, NC, S)), jnp.int32),
+        "mc_token_ids": jnp.asarray(rng.randint(0, S, (W, B, NC)), jnp.int32),
+        "lm_labels": jnp.asarray(
+            rng.randint(0, 50257, (W, B, NC, S)), jnp.int32),
+        "mc_label": jnp.asarray(rng.randint(0, NC, (W, B)), jnp.int32),
+        "token_type_ids": jnp.asarray(
+            rng.randint(0, 2, (W, B, NC, S)), jnp.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0),
+                        batch["input_ids"][0, :1], batch["mc_token_ids"][0, :1],
+                        batch["token_type_ids"][0, :1])
+
+    cfg = FedConfig(mode="sketch", error_type="virtual", local_momentum=0.0,
+                    virtual_momentum=0.9, weight_decay=0.0,
+                    num_workers=W, local_batch_size=B,
+                    k=50_000, num_rows=5, num_cols=500_000,
+                    num_clients=100, track_bytes=False, approx_topk=True,
+                    sketch_dtype="bfloat16", num_results_train=2)
+    runtime = FedRuntime(cfg, params, make_gpt2_train_loss(model),
+                         num_clients=cfg.num_clients)
+    state = runtime.init_state()
+    mask = jnp.ones((W, B), bool)
+    ids = jnp.arange(W, dtype=jnp.int32)
+
+    log("compiling + warmup...")
+    t0 = time.time()
+    state, metrics = runtime.round(state, ids, batch, mask, 0.1)
+    float(state.ps_weights[0])
+    log(f"warmup done in {time.time() - t0:.1f}s")
+
+    n_rounds = 10
+    t0 = time.time()
+    for _ in range(n_rounds):
+        state, metrics = runtime.round(state, ids, batch, mask, 0.1)
+    float(state.ps_weights[0])
+    dt = time.time() - t0
+
+    toks = n_rounds * W * B * NC * S
+    tps = toks / dt
+    loss = float(np.asarray(metrics["results"][0]).mean())
+    log(f"{n_rounds} rounds in {dt:.3f}s -> {tps:.0f} tok/s, loss {loss:.3f}")
+    print(json.dumps({
+        "metric": "gpt2_sketch_round_throughput",
+        "value": round(tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tps / NOMINAL_SINGLE_GPU_TOK_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,19 @@ from commefficient_tpu.core.state import FedState
 _FIELDS = [f.name for f in dataclasses.fields(FedState)]
 
 
+def params_fingerprint(params) -> str:
+    """Stable fingerprint of a parameter pytree's STRUCTURE (treedef + leaf
+    shapes/dtypes). ``ps_weights`` is one flat vector whose meaning depends
+    entirely on the ravel order of the param tree — e.g. flipping GPT-2's
+    ``scan_layers`` reorders it — so resume must refuse a checkpoint written
+    under a different layout instead of silently scrambling weights."""
+    import hashlib
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    desc = str(treedef) + "|" + ";".join(
+        f"{tuple(l.shape)}:{l.dtype}" for l in leaves)
+    return hashlib.sha256(desc.encode()).hexdigest()[:16]
+
+
 def save_state(path: str, state: FedState,
                meta: Optional[Dict] = None) -> str:
     """Write ``<path>.npz`` (+ ``<path>.meta.json``) atomically."""
@@ -81,13 +94,16 @@ class CheckpointManager:
     def __init__(self, directory: str, keep_last: int = 3):
         self.directory = directory
         self.keep_last = keep_last
+        # merged into every save's meta (drivers put the params fingerprint
+        # here so resume can detect layout changes)
+        self.default_meta: Dict = {}
 
     def _path(self, epoch: int) -> str:
         return os.path.join(self.directory, f"ckpt_{epoch:06d}")
 
     def save(self, state: FedState, epoch: int,
              meta: Optional[Dict] = None) -> str:
-        meta = dict(meta or {}, epoch=epoch)
+        meta = dict(self.default_meta, **(meta or {}), epoch=epoch)
         out = save_state(self._path(epoch), state, meta)
         self._rotate()
         return out
@@ -112,10 +128,21 @@ class CheckpointManager:
         es = self.epochs()
         return es[-1] if es else None
 
-    def restore_latest(self, sharding=None):
-        """Returns (state, meta) or (None, {})."""
+    def restore_latest(self, sharding=None, expect_fingerprint=None):
+        """Returns (state, meta) or (None, {}). When both the checkpoint's
+        meta and the caller carry a params fingerprint, a mismatch raises
+        instead of resuming into a scrambled flat-weight layout."""
         e = self.latest()
         if e is None:
             return None, {}
-        return (load_state(self._path(e), sharding=sharding),
-                load_meta(self._path(e)))
+        meta = load_meta(self._path(e))
+        saved_fp = meta.get("params_fingerprint")
+        if (expect_fingerprint is not None and saved_fp is not None
+                and saved_fp != expect_fingerprint):
+            raise ValueError(
+                f"checkpoint {self._path(e)} was written under a different "
+                f"parameter layout (fingerprint {saved_fp} != "
+                f"{expect_fingerprint}); the flat ps_weights vector would "
+                "unravel into the wrong weights. Re-create the run or load "
+                "with the original model configuration.")
+        return load_state(self._path(e), sharding=sharding), meta

@@ -129,6 +129,9 @@ class FedConfig:
     # MXU + subsample; ~100x faster encode/decode on TPU) or "hash" (count
     # sketch with exact CSVec cell semantics). Both are linear (r, c) tables.
     sketch_impl: str = "rht"
+    # rht transform compute dtype ("float32" | "bfloat16"); bf16 halves the
+    # transform's HBM traffic at ~1e-3 relative estimate noise
+    sketch_dtype: str = "float32"
 
     # TPU-optimized approximate top-k (lax.approx_max_k, 0.95 recall) for
     # the sparsification selects; exact lax.top_k when False
@@ -273,6 +276,8 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--max_client_batch", type=int, default=512)
     p.add_argument("--sketch_seed", type=int, default=42)
     p.add_argument("--sketch_impl", choices=("rht", "hash"), default="rht")
+    p.add_argument("--sketch_dtype", choices=("float32", "bfloat16"),
+                   default="float32")
     p.add_argument("--approx_topk", action="store_true")
     p.add_argument("--profile_dir", type=str, default="")
     p.add_argument("--remat", action="store_true", dest="do_remat")

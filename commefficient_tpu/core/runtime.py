@@ -82,7 +82,7 @@ class FedRuntime:
         if cfg.mode == "sketch":
             self.cs = make_sketch_impl(
                 cfg.sketch_impl, cfg.grad_size, cfg.num_cols, cfg.num_rows,
-                cfg.num_blocks, seed=cfg.sketch_seed)
+                cfg.num_blocks, seed=cfg.sketch_seed, dtype=cfg.sketch_dtype)
         # Sketch linearity: sum-of-client-sketches == sketch-of-summed-grads,
         # so the O(d·r) encode can run once per round instead of once per
         # client — unless a per-client nonlinearity (table clip) intervenes.
@@ -200,8 +200,10 @@ class FedRuntime:
         client_last_round = state.client_last_round
         if cfg.track_bytes:
             thresholds = state.client_last_round[client_ids]
-            counts = lax.map(
-                lambda t: (state.coord_last_update >= t).sum(), thresholds)
+            # one fused broadcast-compare-reduce over (W, d) — a lax.map here
+            # would run W serialized full-d passes
+            counts = (state.coord_last_update[None, :]
+                      >= thresholds[:, None]).sum(axis=1)
             download_bytes = jnp.zeros(self.num_clients, jnp.float32).at[
                 client_ids].set(4.0 * counts.astype(jnp.float32))
             upload_bytes = jnp.zeros(self.num_clients, jnp.float32).at[

@@ -90,11 +90,14 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
     Returns (ckpt_mgr_or_None, start_epoch, restored_state_or_None)."""
     if not (cfg.do_checkpoint or cfg.do_resume or cfg.checkpoint_every):
         return None, 0, None
-    from commefficient_tpu.checkpoint import CheckpointManager
+    from commefficient_tpu.checkpoint import (CheckpointManager,
+                                              params_fingerprint)
     mgr = CheckpointManager(os.path.join(cfg.checkpoint_path, name))
+    fp = params_fingerprint(runtime.unravel(runtime.initial_weights))
+    mgr.default_meta = {"params_fingerprint": fp}
     if cfg.do_resume:
         restored, meta = mgr.restore_latest(
-            sharding=runtime._state_sharding)
+            sharding=runtime._state_sharding, expect_fingerprint=fp)
         if restored is not None:
             start = int(meta.get("epoch", 0))
             print(f"resumed from epoch {start}")

@@ -42,6 +42,12 @@ class GPT2Config:
     # rematerialize each block on the backward pass (jax.checkpoint):
     # trades recompute FLOPs for HBM — the standard long-context memory move
     remat: bool = False
+    # lax.scan over the layer stack (stacked block params) instead of
+    # unrolling n_layer blocks into the graph: XLA compiles ONE block body,
+    # cutting compile time ~n_layer-fold for deep models — essential when
+    # the whole federated round (vmap over clients x grad x microbatch scan)
+    # wraps the model
+    scan_layers: bool = True
 
     @property
     def total_vocab(self) -> int:
@@ -96,6 +102,18 @@ class Block(nn.Module):
         return x
 
 
+class _ScanBody(nn.Module):
+    """carry/out adapter so ``nn.scan`` can drive a plain x->x Block."""
+
+    block_cls: Callable
+    cfg: GPT2Config
+    attn_impl: Callable
+
+    @nn.compact
+    def __call__(self, x, _):
+        return self.block_cls(self.cfg, self.attn_impl, name="block")(x), None
+
+
 class GPT2Backbone(nn.Module):
     cfg: GPT2Config
     attn_impl: Callable = dense_causal_attention
@@ -115,8 +133,15 @@ class GPT2Backbone(nn.Module):
             x = x + wte[token_type_ids]
         x = x.astype(cfg.compute_dtype)
         block_cls = nn.remat(Block) if cfg.remat else Block
-        for i in range(cfg.n_layer):
-            x = block_cls(cfg, self.attn_impl, name=f"h{i}")(x)
+        if cfg.scan_layers:
+            scanned = nn.scan(
+                _ScanBody, variable_axes={"params": 0},
+                split_rngs={"params": True}, length=cfg.n_layer,
+                metadata_params={nn.meta.PARTITION_NAME: None})
+            x, _ = scanned(block_cls, cfg, self.attn_impl, name="h")(x, None)
+        else:
+            for i in range(cfg.n_layer):
+                x = block_cls(cfg, self.attn_impl, name=f"h{i}")(x)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          name="ln_f")(x)
         return x, wte
@@ -182,22 +207,32 @@ def load_hf_weights(params, cfg: GPT2Config, checkpoint: str = "gpt2"):
                   (cfg.total_vocab - wte.shape[0], 1))
     tr["wte"] = jnp.asarray(np.concatenate([wte, pad], 0))
     tr["wpe"] = jnp.asarray(sd["wpe.weight"][: cfg.n_positions])
-    for i in range(cfg.n_layer):
-        b = tr[f"h{i}"]
-        hfp = f"h.{i}."
-        # HF GPT-2 uses Conv1D: weights already (in, out) — matches Dense
-        b["c_attn"]["kernel"] = jnp.asarray(sd[hfp + "attn.c_attn.weight"])
-        b["c_attn"]["bias"] = jnp.asarray(sd[hfp + "attn.c_attn.bias"])
-        b["c_proj"]["kernel"] = jnp.asarray(sd[hfp + "attn.c_proj.weight"])
-        b["c_proj"]["bias"] = jnp.asarray(sd[hfp + "attn.c_proj.bias"])
-        b["c_fc"]["kernel"] = jnp.asarray(sd[hfp + "mlp.c_fc.weight"])
-        b["c_fc"]["bias"] = jnp.asarray(sd[hfp + "mlp.c_fc.bias"])
-        b["mlp_proj"]["kernel"] = jnp.asarray(sd[hfp + "mlp.c_proj.weight"])
-        b["mlp_proj"]["bias"] = jnp.asarray(sd[hfp + "mlp.c_proj.bias"])
-        b["ln_1"]["scale"] = jnp.asarray(sd[hfp + "ln_1.weight"])
-        b["ln_1"]["bias"] = jnp.asarray(sd[hfp + "ln_1.bias"])
-        b["ln_2"]["scale"] = jnp.asarray(sd[hfp + "ln_2.weight"])
-        b["ln_2"]["bias"] = jnp.asarray(sd[hfp + "ln_2.bias"])
+
+    # HF GPT-2 uses Conv1D: weights already (in, out) — matches Dense
+    hf_of = {("c_attn", "kernel"): "attn.c_attn.weight",
+             ("c_attn", "bias"): "attn.c_attn.bias",
+             ("c_proj", "kernel"): "attn.c_proj.weight",
+             ("c_proj", "bias"): "attn.c_proj.bias",
+             ("c_fc", "kernel"): "mlp.c_fc.weight",
+             ("c_fc", "bias"): "mlp.c_fc.bias",
+             ("mlp_proj", "kernel"): "mlp.c_proj.weight",
+             ("mlp_proj", "bias"): "mlp.c_proj.bias",
+             ("ln_1", "scale"): "ln_1.weight",
+             ("ln_1", "bias"): "ln_1.bias",
+             ("ln_2", "scale"): "ln_2.weight",
+             ("ln_2", "bias"): "ln_2.bias"}
+    if cfg.scan_layers:
+        # scan-over-layers layout: one "h/block" subtree with the layer axis
+        # stacked as each leaf's leading dim
+        b = tr["h"]["block"]
+        for (mod, leaf), hf_name in hf_of.items():
+            b[mod][leaf] = jnp.asarray(np.stack(
+                [sd[f"h.{i}.{hf_name}"] for i in range(cfg.n_layer)]))
+    else:
+        for i in range(cfg.n_layer):
+            b = tr[f"h{i}"]
+            for (mod, leaf), hf_name in hf_of.items():
+                b[mod][leaf] = jnp.asarray(sd[f"h.{i}.{hf_name}"])
     tr["ln_f"]["scale"] = jnp.asarray(sd["ln_f.weight"])
     tr["ln_f"]["bias"] = jnp.asarray(sd["ln_f.bias"])
     return p

@@ -1,23 +1,31 @@
-from commefficient_tpu.ops.topk import topk, clip_by_l2_norm
+from commefficient_tpu.ops.topk import (topk, topk_with_idx, median_axis0,
+                                        clip_by_l2_norm)
 from commefficient_tpu.ops.pytree import ravel_params, make_unraveler
 from commefficient_tpu.ops.sketch import (
     CountSketch,
     make_sketch,
+    make_sketch_impl,
     sketch_encode,
     sketch_decode,
     sketch_unsketch,
     sketch_l2estimate,
 )
+from commefficient_tpu.ops.rht import RHTSketch, make_rht_sketch
 
 __all__ = [
     "topk",
+    "topk_with_idx",
+    "median_axis0",
     "clip_by_l2_norm",
     "ravel_params",
     "make_unraveler",
     "CountSketch",
     "make_sketch",
+    "make_sketch_impl",
     "sketch_encode",
     "sketch_decode",
     "sketch_unsketch",
     "sketch_l2estimate",
+    "RHTSketch",
+    "make_rht_sketch",
 ]

@@ -71,9 +71,10 @@ from commefficient_tpu.ops.topk import median_axis0, topk_with_idx
 _U32 = jnp.uint32
 
 # precompute ±1 signs when the (r, d') table is at most this many entries
-# (int8 => bytes); above it (e.g. GPT-2: 5 x 134M = 670 MB) recompute on the
-# fly from the hash mixer instead of spending HBM
-_PRECOMPUTE_SIGN_LIMIT = 1 << 28
+# (int8 => bytes, e.g. GPT-2: 5 x 134M = 670 MB — reading that back is ~1 ms
+# where hashing 670M murmur mixes per encode costs ~450 ms); above it,
+# recompute on the fly from the hash mixer instead of spending HBM
+_PRECOMPUTE_SIGN_LIMIT = 1 << 30
 
 
 def _next_pow2(n: int) -> int:
@@ -114,6 +115,16 @@ class RHTSketch:
     r: int
     dp: int                 # padded pow2 transform size, >= max(d, c)
     m: int                  # stratum width, ceil(dp / c)
+    # transform compute dtype name ("float32" | "bfloat16"): bf16 halves the
+    # HBM traffic of the three matmul passes; the ~1e-3 relative noise it
+    # adds is far below the sketch's own estimation noise at any compressing
+    # c < d (keep f32 when exact lossless round-trips matter)
+    dtype: str = "float32"
+    # process the r rows one at a time under lax.scan instead of as one
+    # (B*r, dp) batch: peak transform memory drops r-fold. Auto-enabled for
+    # large dp (GPT-2 scale: a batched (2*5, 2^27) f32 transform plus its
+    # layout copies needs >16 GB HBM and OOMs a v5e chip)
+    scan_rows: bool = False
 
     # server_update dispatches on this: a dense transform has no sparse
     # "occupied cells", so error feedback must be subtractive (see core/server)
@@ -121,7 +132,9 @@ class RHTSketch:
 
     def tree_flatten(self):
         return ((self.sign_keys, self.signs_i8, self.offsets, self.scales,
-                 self.hadamards), (self.d, self.c, self.r, self.dp, self.m))
+                 self.hadamards),
+                (self.d, self.c, self.r, self.dp, self.m, self.dtype,
+                 self.scan_rows))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -151,22 +164,43 @@ class RHTSketch:
         any row count R, as three last-axis matmuls with layout rotations in
         between (net layout change: identity)."""
         n1, n2, n3 = (h.shape[0] for h in self.hadamards)
-        h1, h2, h3 = self.hadamards
+        dt = jnp.dtype(self.dtype)
+        h1, h2, h3 = (h.astype(dt) for h in self.hadamards)
         R = y.shape[0]
-        x = y.reshape(R, n1, n2, n3)
+        x = y.astype(dt).reshape(R, n1, n2, n3)
         x = jnp.matmul(x.reshape(-1, n3), h3).reshape(R, n1, n2, n3)
         x = x.transpose(0, 1, 3, 2)
         x = jnp.matmul(x.reshape(-1, n2), h2).reshape(R, n1, n3, n2)
         x = x.transpose(0, 3, 2, 1)
         x = jnp.matmul(x.reshape(-1, n1), h1).reshape(R, n2, n3, n1)
         x = x.transpose(0, 3, 1, 2)
-        return x.reshape(R, self.dp) * np.float32(1.0 / np.sqrt(self.dp))
+        return x.reshape(R, self.dp).astype(jnp.float32) * np.float32(
+            1.0 / np.sqrt(self.dp))
 
     def _onehot(self) -> jax.Array:
         """(r, m, c) one-hot stratum-selection mask (fused into consumers);
         entry [row, j, s] selects transformed coordinate j*c + s."""
         return (jnp.arange(self.m, dtype=jnp.int32)[None, :, None]
                 == self.offsets[:, None, :]).astype(jnp.float32)
+
+    def _signs_row(self, j) -> jax.Array:
+        """(dp,) ±1 signs of row j (j may be a tracer under lax.scan)."""
+        if self.signs_i8 is not None:
+            row = jax.lax.dynamic_index_in_dim(self.signs_i8, j, axis=0,
+                                               keepdims=False)
+            return row.astype(jnp.float32)
+        i = jnp.arange(self.dp, dtype=_U32)
+        key = jax.lax.dynamic_index_in_dim(self.sign_keys, j, axis=0,
+                                           keepdims=False)
+        h = _mix32(i * key + _U32(0x9E3779B9))
+        return 1.0 - 2.0 * (h >> 31).astype(jnp.float32)
+
+    def _onehot_row(self, j) -> jax.Array:
+        """(m, c) one-hot mask of row j."""
+        off = jax.lax.dynamic_index_in_dim(self.offsets, j, axis=0,
+                                           keepdims=False)
+        return (jnp.arange(self.m, dtype=jnp.int32)[:, None]
+                == off[None, :]).astype(jnp.float32)
 
     # -------------------------------------------------------------------- api
 
@@ -177,11 +211,21 @@ class RHTSketch:
         B = V.shape[0]
         assert V.shape[1] == self.d, (vec.shape, self.d)
         v = jnp.pad(V.astype(jnp.float32), ((0, 0), (0, self.dp - self.d)))
-        y = (self._signs()[None] * v[:, None, :]).reshape(B * self.r, self.dp)
-        z = self._transform(y)
-        z = jnp.pad(z, ((0, 0), (0, self.c * self.m - self.dp)))
-        z = z.reshape(B, self.r, self.m, self.c)
-        t = (z * self._onehot()[None]).sum(axis=2)
+        if self.scan_rows:
+            def body(_, j):
+                z = self._transform(self._signs_row(j)[None] * v)  # (B, dp)
+                z = jnp.pad(z, ((0, 0), (0, self.c * self.m - self.dp)))
+                z = z.reshape(B, self.m, self.c)
+                return None, (z * self._onehot_row(j)[None]).sum(axis=1)
+            _, ts = jax.lax.scan(body, None, jnp.arange(self.r))  # (r, B, c)
+            t = ts.transpose(1, 0, 2)
+        else:
+            y = (self._signs()[None] * v[:, None, :]).reshape(
+                B * self.r, self.dp)
+            z = self._transform(y)
+            z = jnp.pad(z, ((0, 0), (0, self.c * self.m - self.dp)))
+            z = z.reshape(B, self.r, self.m, self.c)
+            t = (z * self._onehot()[None]).sum(axis=2)
         return t if batched else t[0]
 
     def encode_at(self, vec: jax.Array, idx: jax.Array) -> jax.Array:
@@ -197,11 +241,31 @@ class RHTSketch:
         T = table if batched else table[None]
         B = T.shape[0]
         assert T.shape[1:] == self.table_shape, (table.shape, self.table_shape)
-        z = (T * self.scales[None, None, :])[:, :, None, :] * self._onehot()[None]
-        z = z.reshape(B * self.r, self.c * self.m)[:, : self.dp]
-        y = self._signs()[None] * self._transform(z).reshape(
-            B, self.r, self.dp)
-        est = jax.vmap(median_axis0)(y)[:, : self.d]
+        if self.scan_rows:
+            dt = jnp.dtype(self.dtype)
+
+            def body(_, j):
+                tj = jax.lax.dynamic_index_in_dim(T, j, axis=1,
+                                                  keepdims=False)  # (B, c)
+                z = ((tj * self.scales[None, :])[:, None, :]
+                     * self._onehot_row(j)[None])            # (B, m, c)
+                z = z.reshape(B, self.c * self.m)[:, : self.dp]
+                y = self._signs_row(j)[None] * self._transform(z)
+                # store per-row estimates in the transform dtype: the
+                # stacked (r, B, dp) buffer is the peak allocation here
+                return None, y.astype(dt)
+            _, ys = jax.lax.scan(body, None, jnp.arange(self.r))  # (r, B, dp)
+            # median_axis0 reduces axis 0 with arbitrary trailing dims — no
+            # transpose (which would materialize a second full-size copy in
+            # exactly the memory-critical path scan_rows exists to shrink)
+            est = median_axis0(ys.astype(jnp.float32))[:, : self.d]
+        else:
+            z = (T * self.scales[None, None, :])[:, :, None, :] \
+                * self._onehot()[None]
+            z = z.reshape(B * self.r, self.c * self.m)[:, : self.dp]
+            y = self._signs()[None] * self._transform(z).reshape(
+                B, self.r, self.dp)
+            est = jax.vmap(median_axis0)(y)[:, : self.d]
         return est if batched else est[0]
 
     def unsketch_with_idx(self, table: jax.Array, k: int,
@@ -226,22 +290,34 @@ class RHTSketch:
         return table * scale
 
 
-def make_rht_sketch(d: int, c: int, r: int, seed: int = 42) -> RHTSketch:
-    """Build a stratified SRHT sketch for d-vectors with an (r, c) table."""
+def make_rht_sketch(d: int, c: int, r: int, seed: int = 42,
+                    dtype: str = "float32",
+                    scan_rows: Optional[bool] = None) -> RHTSketch:
+    """Build a stratified SRHT sketch for d-vectors with an (r, c) table.
+    ``scan_rows`` defaults to automatic: row-at-a-time transforms once dp
+    reaches 2^25 (large models), full-batch below."""
     dp = max(_next_pow2(d), _next_pow2(c))
+    if scan_rows is None:
+        scan_rows = dp >= (1 << 25)
     m = -(-dp // c)  # ceil: stratum width
     rng = np.random.RandomState(seed)
     sign_keys = rng.randint(1, 2**32, size=(r,),
                             dtype=np.uint64).astype(np.uint32) | 1
     signs_i8 = None
     if r * dp <= _PRECOMPUTE_SIGN_LIMIT:
+        # int8 end to end: an int64 randint intermediate would transiently
+        # cost 8x the final buffer (~5 GB host RAM at GPT-2 scale)
         signs_i8 = jnp.asarray(
-            (rng.randint(0, 2, size=(r, dp)) * 2 - 1).astype(np.int8))
+            rng.randint(0, 2, size=(r, dp), dtype=np.int8) * 2 - 1)
     # interleaved stratum s = {s, s+c, s+2c, ...}: |stratum s| = #j with
-    # j*c + s < dp — balanced within 1 across all c strata for any c <= dp
+    # j*c + s < dp — balanced within 1 across all c strata for any c <= dp.
+    # Independent RNG stream: the offsets must not depend on whether the
+    # sign table was precomputed above (same seed => same sketch either way)
+    rng_off = np.random.RandomState(seed ^ 0x5EED5)
     sizes = -(-(dp - np.arange(c)) // c)
-    offsets = rng.randint(0, sizes[None, :], size=(r, c)).astype(np.int32)
+    offsets = rng_off.randint(0, sizes[None, :], size=(r, c)).astype(np.int32)
     hadamards = tuple(jnp.asarray(_hadamard(n)) for n in _kron_dims(dp))
     return RHTSketch(jnp.asarray(sign_keys), signs_i8,
                      jnp.asarray(offsets), jnp.asarray(sizes, jnp.float32),
-                     hadamards, d=d, c=c, r=r, dp=dp, m=m)
+                     hadamards, d=d, c=c, r=r, dp=dp, m=m, dtype=dtype,
+                     scan_rows=scan_rows)

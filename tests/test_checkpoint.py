@@ -69,3 +69,33 @@ def test_rotation(tmp_path):
         mgr.save(state, epoch=e)
     assert mgr.epochs() == [3, 4]
     assert mgr.latest() == 4
+
+
+def test_fingerprint_guard(tmp_path):
+    """A checkpoint written under one parameter layout must refuse to resume
+    under another (the flat ps_weights vector would unravel into the wrong
+    weights — e.g. flipping GPT-2's scan_layers)."""
+    import jax.numpy as jnp
+    import pytest
+    from commefficient_tpu.checkpoint import params_fingerprint
+
+    rt = build_runtime()
+    state = rt.init_state()
+    mgr = CheckpointManager(str(tmp_path / "fp"))
+    layout_a = {"w": jnp.zeros((3, 4))}
+    layout_b = {"w0": jnp.zeros((4,)), "w1": jnp.zeros((3, 4))}
+    fp_a, fp_b = params_fingerprint(layout_a), params_fingerprint(layout_b)
+    assert fp_a != fp_b
+    mgr.default_meta = {"params_fingerprint": fp_a}
+    mgr.save(state, epoch=0)
+    # same layout: fine
+    restored, _ = mgr.restore_latest(expect_fingerprint=fp_a)
+    assert restored is not None
+    # different layout: refused
+    with pytest.raises(ValueError, match="different parameter layout"):
+        mgr.restore_latest(expect_fingerprint=fp_b)
+    # legacy checkpoints without a fingerprint still load
+    mgr2 = CheckpointManager(str(tmp_path / "fp2"))
+    mgr2.save(state, epoch=0)
+    restored, _ = mgr2.restore_latest(expect_fingerprint=fp_a)
+    assert restored is not None
