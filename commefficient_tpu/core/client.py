@@ -52,12 +52,11 @@ def make_forward_grad(
     loss_fn: Callable,
     unravel: Callable[[jax.Array], Any],
     batch_size: int,
-    cs: Optional[Any] = None,
     defer_encode: bool = False,
 ):
     """Build the microbatched forward/backward (reference fed_worker.py:249-335).
 
-    Returns ``fwd(params_vec, batch, mask, rng) -> (g, results, n_valid)``
+    Returns ``fwd(params_vec, batch, mask, rng, cs) -> (g, results, n_valid)``
     where ``g`` is in transmitted space: the accumulated sum over microbatches
     of per-microbatch mean gradients (matching the reference's
     ``loss.backward()`` accumulation), with decoupled weight decay
@@ -66,8 +65,6 @@ def make_forward_grad(
     """
     num_iters, mb = _num_microbatches(cfg, batch_size)
     pad_to = num_iters * mb
-    if cfg.mode == "sketch":
-        assert cs is not None, "sketch mode requires the runtime's sketch"
 
     def loss_on_vec(vec, mb_batch, mb_mask):
         loss, metrics = loss_fn(unravel(vec), mb_batch, mb_mask)
@@ -75,7 +72,11 @@ def make_forward_grad(
 
     grad_fn = jax.value_and_grad(loss_on_vec, has_aux=True)
 
-    def fwd(params_vec, batch, mask, rng):
+    def fwd(params_vec, batch, mask, rng, cs=None):
+        # ``cs`` is threaded as a CALL-TIME argument (not a closure): its
+        # arrays — at GPT-2 scale the int8 sign table alone is ~670 MB —
+        # must be jit inputs, not constants baked into (and shipped with)
+        # the serialized HLO
         mask = mask.astype(jnp.float32)
         if pad_to != batch_size:
             pad = pad_to - batch_size
@@ -130,6 +131,7 @@ def make_forward_grad(
         # cross-client sum instead of once per client — legal whenever no
         # per-client nonlinearity acts on the table (no table clip).
         if cfg.mode == "sketch" and not defer_encode:
+            assert cs is not None, "sketch mode requires the runtime's sketch"
             table = cs.encode(g)
             if cfg.max_grad_norm is not None:
                 table = cs.clip(table, cfg.max_grad_norm)
@@ -144,21 +146,22 @@ def make_client_step(
     loss_fn: Callable,
     unravel: Callable[[jax.Array], Any],
     batch_size: int,
-    cs: Optional[Any] = None,
     defer_encode: bool = False,
 ):
     """Single-round client step: forward_grad + local momentum / error /
     local-topk pipeline (reference fed_worker.py:184-230).
 
-    Returns ``step(params_vec, batch, mask, velocity, error, rng) -> ClientOut``.
+    Returns ``step(params_vec, batch, mask, velocity, error, rng, cs)
+    -> ClientOut``.
     ``velocity``/``error`` are this client's persistent rows (or None when the
     mode doesn't allocate them, reference fed_aggregator.py:105-129).
     """
-    fwd = make_forward_grad(cfg, loss_fn, unravel, batch_size, cs,
+    fwd = make_forward_grad(cfg, loss_fn, unravel, batch_size,
                             defer_encode=defer_encode)
 
-    def step(params_vec, batch, mask, velocity, error, rng) -> ClientOut:
-        g, results, n_valid = fwd(params_vec, batch, mask, rng)
+    def step(params_vec, batch, mask, velocity, error, rng,
+             cs=None) -> ClientOut:
+        g, results, n_valid = fwd(params_vec, batch, mask, rng, cs)
         # weight by datum count: the server divides by the round's total
         # (reference fed_worker.py:190, fed_aggregator.py:332)
         g = g * n_valid
@@ -194,7 +197,6 @@ def make_fedavg_client(
     loss_fn: Callable,
     unravel: Callable[[jax.Array], Any],
     batch_size: int,
-    cs: Optional[Any] = None,
 ):
     """FedAvg local-SGD loop (reference fed_worker.py:61-113).
 
@@ -203,7 +205,8 @@ def make_fedavg_client(
     epochs of local SGD with per-step decay ``fedavg_lr_decay**step``, and
     the dataset-size-weighted weight delta is transmitted.
 
-    Returns ``step(params_vec, batch, mask, lr, rng) -> ClientOut``.
+    Returns ``step(params_vec, batch, mask, lr, rng) -> ClientOut``
+    (fedavg transmits raw weight deltas; no sketch argument).
     """
     if cfg.fedavg_batch_size == -1:
         chunk = batch_size
@@ -211,7 +214,7 @@ def make_fedavg_client(
         chunk = min(cfg.fedavg_batch_size, batch_size)
     n_chunks = math.ceil(batch_size / chunk)
     pad_to = n_chunks * chunk
-    fwd = make_forward_grad(cfg, loss_fn, unravel, chunk, cs)
+    fwd = make_forward_grad(cfg, loss_fn, unravel, chunk)
 
     def step(params_vec, batch, mask, lr, rng) -> ClientOut:
         mask = mask.astype(jnp.float32)

@@ -95,15 +95,15 @@ class FedRuntime:
         # reference's encode-before-NCCL-reduce).
         self._defer_encode = (cfg.mode == "sketch"
                               and cfg.max_grad_norm is None)
-        # With deferred encode AND the SRHT subtractive server rule, every
-        # table the server ever holds is enc(<some dense vector>) — encode is
-        # linear and the rule only ever adds/subtracts encodes. So the
-        # momentum/error state can live as dense (d,) PRE-IMAGES: the
-        # enc(update)/enc(masked-velocity) subtractions become free dense
-        # subtractions and the whole server pass needs exactly one batched
-        # encode+decode round-trip (which is where FetchSGD's compression
-        # noise enters). Bit-identical (up to fp reassociation) to the
-        # table-space rule; see core/server.py dense_preimage branch.
+        # With deferred encode on a single device, the server can keep
+        # momentum/error as dense (d,) PRE-IMAGES instead of (r, c) tables:
+        # one enc+dec round-trip of the error per round injects the sketch's
+        # compression noise (that round-trip IS what the server sees through
+        # the compressed channel), and the reference's error-feedback /
+        # momentum-masking zeroing applies EXACTLY at the update support —
+        # the true_topk rule structure with the sketch round-trip inserted.
+        # See core/server.py dense_preimage branch; reduces to both the
+        # table-space rule and true_topk in the lossless limit.
         # Single-device ONLY: on a mesh the pre-image trick would turn the
         # table-sized psum back into a d-sized dense psum — there the
         # per-shard encode + table-space subtractive rule applies instead.
@@ -113,10 +113,10 @@ class FedRuntime:
         loss_fn_val = loss_fn_val if loss_fn_val is not None else loss_fn_train
         if cfg.mode == "fedavg":
             self._client_fn = client_lib.make_fedavg_client(
-                cfg, loss_fn_train, unravel, self.batch_size, self.cs)
+                cfg, loss_fn_train, unravel, self.batch_size)
         else:
             self._client_fn = client_lib.make_client_step(
-                cfg, loss_fn_train, unravel, self.batch_size, self.cs,
+                cfg, loss_fn_train, unravel, self.batch_size,
                 defer_encode=self._defer_encode)
         self._val_fn_inner = client_lib.make_val_step(cfg, loss_fn_val, unravel)
 
@@ -124,11 +124,12 @@ class FedRuntime:
             sh = self.shardings
             state_sh = sh.for_state(cfg, self._state_template())
             batch_leaf = sh.round_axis
+            cs_sh = jax.tree.map(lambda _: sh.replicated, self.cs)
             self._round = jax.jit(
                 self._round_step,
                 donate_argnums=(0,),
                 in_shardings=(state_sh, batch_leaf, batch_leaf, batch_leaf,
-                              None),
+                              None, cs_sh),
                 out_shardings=(state_sh, None),
             )
             self._state_sharding = state_sh
@@ -141,18 +142,21 @@ class FedRuntime:
 
     def _state_template(self):
         """Structure-only FedState (no allocation) for sharding layout."""
-        return jax.eval_shape(self._make_state, 0)
+        return jax.eval_shape(self._make_state, 0, self.initial_weights)
 
     def init_state(self, seed: Optional[int] = None) -> FedState:
         seed = self.cfg.seed if seed is None else seed
         if self._state_sharding is not None:
             # create the state directly in its sharded layout — no single
-            # device ever holds the full per-client arrays
+            # device ever holds the full per-client arrays. The weights are
+            # a jit ARGUMENT: as a closure constant they would be serialized
+            # into the HLO shipped to the compiler (0.5 GB at GPT-2 scale)
             return jax.jit(self._make_state,
-                           out_shardings=self._state_sharding)(seed)
-        return self._make_state(seed)
+                           out_shardings=self._state_sharding)(
+                               seed, self.initial_weights)
+        return self._make_state(seed, self.initial_weights)
 
-    def _make_state(self, seed) -> FedState:
+    def _make_state(self, seed, initial_weights) -> FedState:
         cfg = self.cfg
         # dense pre-image states for the single-device SRHT path (see
         # __init__); sketch-table shape otherwise
@@ -168,7 +172,7 @@ class FedRuntime:
         return FedState(
             # copy: the round step donates its input state, and the shared
             # self.initial_weights buffer must survive repeated init_state()
-            ps_weights=jnp.array(self.initial_weights, copy=True),
+            ps_weights=jnp.array(initial_weights, copy=True),
             Vvelocity=zeros_tx,
             Verror=jnp.zeros_like(zeros_tx),
             step=jnp.zeros((), jnp.int32),
@@ -177,7 +181,7 @@ class FedRuntime:
             client_errors=maybe((n,) + tx, cfg.needs_client_errors),
             # every client starts with the initial PS weights
             # (reference fed_aggregator.py:105-111)
-            client_weights=(jnp.broadcast_to(self.initial_weights, (n, d))
+            client_weights=(jnp.broadcast_to(initial_weights, (n, d))
                             if cfg.do_topk_down else None),
             coord_last_update=(jnp.full((d,), -1, jnp.int32)
                                if cfg.track_bytes else None),
@@ -188,7 +192,7 @@ class FedRuntime:
     # ------------------------------------------------------------- round step
 
     def _round_step(self, state: FedState, client_ids: jax.Array,
-                    batch: Any, mask: jax.Array, lr: jax.Array):
+                    batch: Any, mask: jax.Array, lr: jax.Array, cs=None):
         cfg = self.cfg
         num_workers = client_ids.shape[0]
         keys = jax.random.split(state.rng, num_workers + 2)
@@ -243,7 +247,7 @@ class FedRuntime:
         has_err = err_rows is not None
 
         def client_block(used_weights, batch, mask, vel_rows, err_rows,
-                         client_rngs, lr):
+                         client_rngs, lr, cs):
             if cfg.mode == "fedavg":
                 out = jax.vmap(
                     self._client_fn,
@@ -254,12 +258,12 @@ class FedRuntime:
                     self._client_fn,
                     in_axes=(params_axis, 0, 0,
                              0 if has_vel else None,
-                             0 if has_err else None, 0))(
+                             0 if has_err else None, 0, None))(
                         used_weights, batch, mask, vel_rows, err_rows,
-                        client_rngs)
+                        client_rngs, cs)
             agg = out.transmit.sum(axis=0)
             if self._defer_encode and not self._dense_preimage:
-                agg = self.cs.encode(agg)
+                agg = cs.encode(agg)
             n_total = out.n_valid.sum()
             if self._axis is not None:
                 agg = lax.psum(agg, self._axis)
@@ -280,6 +284,7 @@ class FedRuntime:
                 row if has_err else None,
                 row,
                 P(),
+                jax.tree.map(lambda _: P(), cs),
             )
             out_specs = (
                 P(), P(),
@@ -296,7 +301,8 @@ class FedRuntime:
                                      check_vma=False)
 
         agg, n_total, vel_new, err_new, results, n_valid = client_block(
-            used_weights, batch, mask, vel_rows, err_rows, client_rngs, lr)
+            used_weights, batch, mask, vel_rows, err_rows, client_rngs, lr,
+            cs)
         out = client_lib.ClientOut(None, vel_new, err_new, results, n_valid)
         total = jnp.maximum(n_total, 1.0)
         agg = agg / total
@@ -305,7 +311,7 @@ class FedRuntime:
         server_lr = jnp.asarray(1.0) if cfg.mode == "fedavg" else lr
         update, Vvel, Verr, sup_mask = server_update(
             cfg, agg, state.Vvelocity, state.Verror, server_lr,
-            cs=self.cs, dp_rng=server_rng,
+            cs=cs, dp_rng=server_rng,
             dense_preimage=self._dense_preimage)
         ps_weights = state.ps_weights - update
 
@@ -359,7 +365,8 @@ class FedRuntime:
         ``batch``: pytree with leaves (num_workers, batch_size, ...);
         ``mask``: (num_workers, batch_size); ``lr``: scalar or (d,) vector."""
         return self._round(state, jnp.asarray(client_ids, jnp.int32), batch,
-                           jnp.asarray(mask), jnp.asarray(lr, jnp.float32))
+                           jnp.asarray(mask), jnp.asarray(lr, jnp.float32),
+                           self.cs)
 
     def val(self, state: FedState, batch, mask):
         """Masked evaluation on the current PS weights; returns

@@ -134,21 +134,24 @@ def server_update(
         assert cs is not None
         if dense_preimage:
             # Single-device SRHT fast path (runtime._dense_preimage):
-            # momentum/error live as dense (d,) pre-images whose encodes are
-            # the tables of the rule below — linearity makes the trajectories
-            # identical while the feedback subtractions become dense ops.
-            # ``gradient`` arrives dense (deferred encode skipped entirely);
-            # one batched enc+dec round-trip injects the sketch noise.
+            # momentum/error live as dense (d,) pre-images; ``gradient``
+            # arrives dense (deferred encode skipped entirely), and ONE
+            # enc+dec round-trip of the error injects the sketch noise —
+            # that round-trip is exactly what the server "sees" through the
+            # compressed channel. Because the pre-images are exact, the
+            # reference's error feedback and momentum factor masking
+            # ("zero Verror/Vvelocity where the update is nonzero",
+            # fed_aggregator.py:596-611) apply EXACTLY at the support — the
+            # structure of the true_topk rule with the sketch round-trip
+            # inserted before the top-k. Reduces to true_topk bit-for-bit in
+            # the lossless limit.
             Vvel = gradient + rho * Vvelocity
             Verr = Verror + Vvel
-            # natively batched (B=2) enc+dec — batch folds into the
-            # transform's row axis; vmap here would break the sketch's fused
-            # selection patterns
-            ests_err, ests_vel = cs.decode(cs.encode(jnp.stack([Verr, Vvel])))
-            update, upd_idx = topk_with_idx(ests_err, k=cfg.k,
+            ests = cs.decode(cs.encode(Verr))
+            update, upd_idx = topk_with_idx(ests, k=cfg.k,
                                             approx=cfg.approx_topk)
-            Verr = Verr - update                       # error feedback
-            Vvel = Vvel.at[upd_idx].add(-ests_vel[upd_idx])  # momentum mask
+            Verr = Verr.at[upd_idx].set(0.0)           # error feedback
+            Vvel = Vvel.at[upd_idx].set(0.0)           # momentum mask
             return update * lr, Vvel, Verr, None
         Vvel = gradient + rho * Vvelocity
         Verr = Verror + Vvel  # virtual error (the only legal type, see above)

@@ -127,7 +127,9 @@ class RHTSketch:
     scan_rows: bool = False
 
     # server_update dispatches on this: a dense transform has no sparse
-    # "occupied cells", so error feedback must be subtractive (see core/server)
+    # "occupied cells", so the table-space (mesh) branch uses subtractive
+    # error feedback, while the single-device dense-preimage branch zeroes
+    # the exact support (see core/server.py sketch branch for both)
     dense_transform = True
 
     def tree_flatten(self):

@@ -132,7 +132,7 @@ class TestGoldenTrajectories:
         """Huge table => estimates are near-exact => FetchSGD reduces to
         true top-k (SURVEY.md §4 golden strategy). For the rht impl the
         lossless limit is exact by construction (c == padded size), which
-        also certifies the subtractive error-feedback rule coincides with
+        certifies the dense-preimage support-zeroing rule coincides with
         the reference's cell-masking there (core/server.py)."""
         d = D_FEAT + 1
         cfg_s = base_cfg(mode="sketch", error_type="virtual", k=d,

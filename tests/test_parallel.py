@@ -50,7 +50,12 @@ def make_batch(seed, W=8, B=4, din=6, dout=3):
     ("uncompressed", {}),
     ("true_topk", {"error_type": "virtual", "k": 5}),
     ("sketch", {"error_type": "virtual", "k": 5, "num_rows": 3,
-                "num_cols": 32, "num_blocks": 2}),
+                "num_cols": 32, "num_blocks": 2, "sketch_impl": "hash"}),
+    # rht: single-device (dense-preimage zeroing) and mesh (table-space
+    # subtractive) rules only coincide in the lossless limit — assert the
+    # exact-equality contract there (c >= padded d => exact round-trip)
+    ("sketch", {"error_type": "virtual", "k": 5, "num_rows": 3,
+                "num_cols": 32, "sketch_impl": "rht"}),
 ])
 def test_sharded_round_matches_single_device(mode, extra):
     cfg = make_cfg(mode=mode, **extra)
