@@ -130,12 +130,34 @@ class FedPERSONA(FedDataset):
     release: {"train": [...], "valid": [...]}) or synthetic fallback."""
 
     def __init__(self, *args, tokenizer=None, num_candidates: int = 2,
-                 max_seq_len: int = 128, synthetic: Optional[bool] = None,
-                 **kw):
+                 max_seq_len: int = 128, max_history: int = 2,
+                 personality_permutations: int = 1,
+                 synthetic: Optional[bool] = None, **kw):
         self.tokenizer = tokenizer or HashTokenizer()
         self.num_candidates = num_candidates
         self.max_seq_len = max_seq_len
+        # history truncation to the last 2*max_history+1 exchanges
+        # (reference fed_persona.py:255) and persona-rotation augmentation
+        # (--personality_permutations, reference utils.py:204-207)
+        self.max_history = max_history
+        self.personality_permutations = personality_permutations
         self._synthetic = synthetic
+        # the packed npz bakes these knobs in at prepare time; changing any
+        # of them must invalidate the cache, not be silently ignored
+        self._prep_config = {"num_candidates": num_candidates,
+                             "max_seq_len": max_seq_len,
+                             "max_history": max_history,
+                             "personality_permutations":
+                                 personality_permutations}
+        cfg_fn = os.path.join(args[0] if args else kw.get("dataset_dir"),
+                              "persona_prep.json")
+        if os.path.exists(cfg_fn):
+            with open(cfg_fn) as f:
+                if json.load(f) != self._prep_config:
+                    stats = os.path.join(os.path.dirname(cfg_fn),
+                                         "stats.json")
+                    if os.path.exists(stats):
+                        os.unlink(stats)  # forces re-preparation
         super().__init__(*args, **kw)
 
     # --------------------------------------------------------- preparation
@@ -155,7 +177,8 @@ class FedPERSONA(FedDataset):
         return (_synthetic_personachat(12, 3, seed=5),
                 _synthetic_personachat(4, 2, seed=6))
 
-    def _pack_split(self, dialogs, by_personality: bool):
+    def _pack_split(self, dialogs, by_personality: bool,
+                    permutations: int = 1):
         tok = self.tokenizer
         C, S = self.num_candidates, self.max_seq_len
         enc = lambda s: tok.encode(s)
@@ -174,42 +197,63 @@ class FedPERSONA(FedDataset):
         for key in sorted(groups):
             n_items = 0
             for d in groups[key]:
-                persona = [enc(s) for s in d["personality"]]
-                for utt in d["utterances"]:
-                    cands = utt["candidates"][-C:]
-                    history = [enc(h) for h in utt["history"]]
-                    ii = np.full((C, S), pad_id, np.int32)
-                    tt = np.full((C, S), pad_id, np.int32)
-                    ll = np.full((C, S), LM_IGNORE, np.int32)
-                    mc = np.zeros((C,), np.int32)
-                    for j, cand in enumerate(cands):
-                        gold = j == len(cands) - 1
-                        inst = build_input_from_segments(
-                            persona, history, enc(cand), tok,
-                            lm_labels=gold)
-                        ids = inst["input_ids"][:S]
-                        ii[j, :len(ids)] = ids
-                        tt[j, :len(ids)] = inst["token_type_ids"][:S]
-                        ll[j, :len(ids)] = inst["lm_labels"][:S]
-                        mc[j] = len(ids) - 1
-                    rows["input_ids"].append(ii)
-                    rows["token_type_ids"].append(tt)
-                    rows["lm_labels"].append(ll)
-                    rows["mc_token_ids"].append(mc)
-                    rows["mc_label"].append(len(cands) - 1)
-                    n_items += 1
+                persona_base = [enc(s) for s in d["personality"]]
+                # tokenize history/candidates ONCE; only the persona order
+                # differs between permutations
+                utts = [
+                    ([enc(h) for h in utt["history"]][
+                        -(2 * self.max_history + 1):],
+                     [enc(c) for c in utt["candidates"][-C:]])
+                    for utt in d["utterances"]]
+                # persona rotation: permutation p sees the sentences rotated
+                # by p (TransferTransfo augmentation the reference exposes
+                # as --personality_permutations; train split only)
+                for perm in range(permutations):
+                    persona = persona_base[perm:] + persona_base[:perm]
+                    for history, cands in utts:
+                        self._append_item(rows, persona, history, cands,
+                                          pad_id, C, S)
+                        n_items += 1
             per_client.append(n_items)
         packed = {k: np.stack(v).astype(np.int32)
                   for k, v in rows.items()}
         return packed, per_client
 
+    def _append_item(self, rows, persona, history, cands, pad_id, C, S):
+        tok = self.tokenizer
+        ii = np.full((C, S), pad_id, np.int32)
+        tt = np.full((C, S), pad_id, np.int32)
+        ll = np.full((C, S), LM_IGNORE, np.int32)
+        mc = np.zeros((C,), np.int32)
+        for j, cand_ids in enumerate(cands):
+            gold = j == len(cands) - 1
+            inst = build_input_from_segments(
+                persona, history, cand_ids, tok, lm_labels=gold)
+            ids = inst["input_ids"][:S]
+            ii[j, :len(ids)] = ids
+            tt[j, :len(ids)] = inst["token_type_ids"][:S]
+            ll[j, :len(ids)] = inst["lm_labels"][:S]
+            mc[j] = len(ids) - 1
+        rows["input_ids"].append(ii)
+        rows["token_type_ids"].append(tt)
+        rows["lm_labels"].append(ll)
+        rows["mc_token_ids"].append(mc)
+        rows["mc_label"].append(len(cands) - 1)
+
     def prepare_datasets(self, download: bool = False) -> None:
         train_raw, val_raw = self._raw_corpus()
-        train, per_client = self._pack_split(train_raw, by_personality=True)
+        train, per_client = self._pack_split(
+            train_raw, by_personality=True,
+            permutations=self.personality_permutations)
+        # validation is never augmented (the reference permutes training
+        # personalities only)
         val, _ = self._pack_split(val_raw, by_personality=True)
         os.makedirs(self.dataset_dir, exist_ok=True)
         np.savez(os.path.join(self.dataset_dir, "persona_train.npz"), **train)
         np.savez(os.path.join(self.dataset_dir, "persona_val.npz"), **val)
+        with open(os.path.join(self.dataset_dir, "persona_prep.json"),
+                  "w") as f:
+            json.dump(self._prep_config, f)
         self.write_stats(self.dataset_dir, per_client,
                          len(val["mc_label"]))
 

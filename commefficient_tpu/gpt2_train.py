@@ -61,10 +61,16 @@ def main(argv=None):
     train_ds = FedPERSONA(cfg.dataset_dir, train=True, do_iid=cfg.do_iid,
                           num_clients=cfg.num_clients, tokenizer=tokenizer,
                           num_candidates=cfg.num_candidates,
-                          max_seq_len=max_seq_len)
+                          max_seq_len=max_seq_len,
+                          max_history=cfg.max_history,
+                          personality_permutations=cfg.personality_permutations)
+    # same prep config as train (a differing config would invalidate the
+    # shared npz cache); permutations only augment the TRAIN pack
     val_ds = FedPERSONA(cfg.dataset_dir, train=False, tokenizer=tokenizer,
                         num_candidates=cfg.num_candidates,
-                        max_seq_len=max_seq_len)
+                        max_seq_len=max_seq_len,
+                        max_history=cfg.max_history,
+                        personality_permutations=cfg.personality_permutations)
     cfg = cfg.replace(num_clients=train_ds.num_clients)
 
     model, gcfg = build_gpt2(cfg, tokenizer)

@@ -247,3 +247,32 @@ class TestLocalState:
                                      lr=0.025)
         for got, want in zip(traj_b, traj_a):
             np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+
+
+class TestPerParamLR:
+    def test_vector_lr_scales_update_per_coordinate(self):
+        """The reference's Fixup param groups yield a per-parameter LR
+        vector from FedOptimizer.get_lr (fed_aggregator.py:411-427); our
+        round accepts a (d,) lr and must scale each coordinate's update by
+        its own rate — equivalent to running with scalar lr and rescaling."""
+        cfg = base_cfg()
+        params = init_params()
+        xs, ys = make_data()
+        rt = FedRuntime(cfg, params, loss_fn, num_clients=NUM_CLIENTS)
+        d = rt.cfg.grad_size
+        mult = np.ones(d, np.float32)
+        mult[: d // 2] = 0.1
+        ids = np.arange(W, dtype=np.int32)
+        batch = {"x": jnp.asarray(xs[ids]), "y": jnp.asarray(ys[ids])}
+        mask = jnp.ones((W, B))
+
+        s_vec = rt.init_state()
+        s_vec, _ = rt.round(s_vec, ids, batch, mask, 0.05 * mult)
+        s_ref = rt.init_state()
+        s_ref, _ = rt.round(s_ref, ids, batch, mask, 0.05)
+
+        w0 = np.asarray(rt.init_state().ps_weights)
+        upd_vec = w0 - np.asarray(s_vec.ps_weights)
+        upd_ref = w0 - np.asarray(s_ref.ps_weights)
+        np.testing.assert_allclose(upd_vec, upd_ref * mult,
+                                   rtol=1e-5, atol=1e-7)

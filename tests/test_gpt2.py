@@ -115,3 +115,25 @@ def test_lm_head_variant():
     params = model.init(jax.random.PRNGKey(0), ids)
     y = model.apply(params, ids)
     assert y.shape == (2, 16, cfg.total_vocab)
+
+
+def test_persona_history_and_permutations(tmp_path):
+    """--max_history truncates to the last 2*h+1 exchanges (reference
+    fed_persona.py:255) and --personality_permutations multiplies items with
+    rotated persona sentences (reference utils.py:204-207)."""
+    from commefficient_tpu.data.fed_persona import FedPERSONA
+
+    base = FedPERSONA(str(tmp_path / "p1"), train=True, synthetic=True,
+                      max_history=2, personality_permutations=1)
+    perm = FedPERSONA(str(tmp_path / "p2"), train=True, synthetic=True,
+                      max_history=2, personality_permutations=3)
+    assert len(perm) == 3 * len(base)
+    # shorter history => sequences can only get shorter or equal
+    short = FedPERSONA(str(tmp_path / "p3"), train=True, synthetic=True,
+                       max_history=0, personality_permutations=1)
+    lens_base = (base.arrays["input_ids"] !=
+                 base.tokenizer.convert_tokens_to_ids("<pad>")).sum()
+    lens_short = (short.arrays["input_ids"] !=
+                  short.tokenizer.convert_tokens_to_ids("<pad>")).sum()
+    assert lens_short <= lens_base
+    assert len(short) == len(base)
