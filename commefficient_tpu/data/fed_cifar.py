@@ -101,7 +101,7 @@ class FedCIFAR10(FedDataset):
             np.save(self.client_fn(c), train_images[sel])
         np.savez(self.test_fn(), test_images=test_images,
                  test_targets=test_targets)
-        self.write_stats(self.dataset_dir, images_per_client,
+        self.write_stats(images_per_client,
                          len(test_targets))
 
     # ------------------------------------------------------------- loading

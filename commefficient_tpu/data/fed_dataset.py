@@ -56,7 +56,11 @@ class FedDataset:
     # ---------------------------------------------------------------- meta
 
     def stats_fn(self) -> str:
-        return os.path.join(self.dataset_dir, "stats.json")
+        # namespaced per dataset class: several datasets may share one
+        # dataset_dir (the drivers' default is ./dataset for all), and one
+        # dataset's stats must not make another skip its preparation
+        return os.path.join(self.dataset_dir,
+                            f"stats_{type(self).__name__}.json")
 
     def _load_meta(self) -> None:
         with open(self.stats_fn()) as f:
@@ -141,11 +145,10 @@ class FedDataset:
 
     # ------------------------------------------------------------- helpers
 
-    @staticmethod
-    def write_stats(dataset_dir: str, images_per_client, num_val_images: int,
+    def write_stats(self, images_per_client, num_val_images: int,
                     **extra) -> None:
-        os.makedirs(dataset_dir, exist_ok=True)
+        os.makedirs(self.dataset_dir, exist_ok=True)
         stats = {"images_per_client": [int(x) for x in images_per_client],
                  "num_val_images": int(num_val_images), **extra}
-        with open(os.path.join(dataset_dir, "stats.json"), "w") as f:
+        with open(self.stats_fn(), "w") as f:
             json.dump(stats, f)

@@ -91,7 +91,7 @@ class FedEMNIST(FedDataset):
         vx, vy = val[0], val[1]
         np.savez(os.path.join(self.dataset_dir, "val.npz"),
                  images=vx, targets=vy)
-        self.write_stats(self.dataset_dir, per_client, len(vy))
+        self.write_stats(per_client, len(vy))
 
     def _load_arrays(self) -> None:
         fn = "train.npz" if self.train else "val.npz"

@@ -55,7 +55,7 @@ class FedImageNet(FedCIFAR10):
             np.save(self.client_fn(c), train_images[sel])
         np.savez(self.test_fn(), test_images=test_images,
                  test_targets=test_targets)
-        self.write_stats(self.dataset_dir, images_per_client,
+        self.write_stats(images_per_client,
                          len(test_targets))
 
     def _prepare_from_tree(self, train_root: str) -> None:
@@ -86,7 +86,7 @@ class FedImageNet(FedCIFAR10):
                        else np.zeros((0, sz, sz, 3), np.uint8))
         np.savez(self.test_fn(), test_images=test_images,
                  test_targets=np.asarray(test_targets, np.int64))
-        self.write_stats(self.dataset_dir, images_per_client,
+        self.write_stats(images_per_client,
                          len(test_targets))
 
     def _load_arrays(self) -> None:

@@ -142,22 +142,29 @@ class FedPERSONA(FedDataset):
         self.max_history = max_history
         self.personality_permutations = personality_permutations
         self._synthetic = synthetic
-        # the packed npz bakes these knobs in at prepare time; changing any
-        # of them must invalidate the cache, not be silently ignored
-        self._prep_config = {"num_candidates": num_candidates,
-                             "max_seq_len": max_seq_len,
-                             "max_history": max_history,
-                             "personality_permutations":
-                                 personality_permutations}
-        cfg_fn = os.path.join(args[0] if args else kw.get("dataset_dir"),
-                              "persona_prep.json")
+        # the packed npz bakes these knobs — and the tokenizer vocabulary
+        # and corpus source — in at prepare time; changing any of them must
+        # invalidate the cache, not be silently ignored
+        self.dataset_dir = args[0] if args else kw.get("dataset_dir")
+        corpus_json = os.path.join(self.dataset_dir,
+                                   "personachat_self_original.json")
+        self._prep_config = {
+            "num_candidates": num_candidates,
+            "max_seq_len": max_seq_len,
+            "max_history": max_history,
+            "personality_permutations": personality_permutations,
+            "tokenizer": (type(self.tokenizer).__name__,
+                          len(self.tokenizer)),
+            "corpus": ("real" if (os.path.exists(corpus_json)
+                                  and not synthetic) else "synthetic"),
+        }
+        cfg_fn = os.path.join(self.dataset_dir, "persona_prep.json")
         if os.path.exists(cfg_fn):
             with open(cfg_fn) as f:
-                if json.load(f) != self._prep_config:
-                    stats = os.path.join(os.path.dirname(cfg_fn),
-                                         "stats.json")
-                    if os.path.exists(stats):
-                        os.unlink(stats)  # forces re-preparation
+                if json.load(f) != json.loads(
+                        json.dumps(self._prep_config)):
+                    if os.path.exists(self.stats_fn()):
+                        os.unlink(self.stats_fn())  # forces re-preparation
         super().__init__(*args, **kw)
 
     # --------------------------------------------------------- preparation
@@ -201,8 +208,8 @@ class FedPERSONA(FedDataset):
                 # tokenize history/candidates ONCE; only the persona order
                 # differs between permutations
                 utts = [
-                    ([enc(h) for h in utt["history"]][
-                        -(2 * self.max_history + 1):],
+                    ([enc(h) for h in
+                      utt["history"][-(2 * self.max_history + 1):]],
                      [enc(c) for c in utt["candidates"][-C:]])
                     for utt in d["utterances"]]
                 # persona rotation: permutation p sees the sentences rotated
@@ -254,8 +261,7 @@ class FedPERSONA(FedDataset):
         with open(os.path.join(self.dataset_dir, "persona_prep.json"),
                   "w") as f:
             json.dump(self._prep_config, f)
-        self.write_stats(self.dataset_dir, per_client,
-                         len(val["mc_label"]))
+        self.write_stats(per_client, len(val["mc_label"]))
 
     def _load_arrays(self) -> None:
         fn = "persona_train.npz" if self.train else "persona_val.npz"
