@@ -132,6 +132,10 @@ class FedConfig:
     # rht transform compute dtype ("float32" | "bfloat16"); bf16 halves the
     # transform's HBM traffic at ~1e-3 relative estimate noise
     sketch_dtype: str = "float32"
+    # rht row-at-a-time transforms (memory mode): -1 auto (on at dp >= 2^25),
+    # 0 force batched, 1 force scanned. bf16 single-vector round-trips fit
+    # batched even at GPT-2 scale and run ~2x faster
+    sketch_scan_rows: int = -1
 
     # TPU-optimized approximate top-k (lax.approx_max_k, 0.95 recall) for
     # the sparsification selects; exact lax.top_k when False
@@ -278,6 +282,8 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--sketch_impl", choices=("rht", "hash"), default="rht")
     p.add_argument("--sketch_dtype", choices=("float32", "bfloat16"),
                    default="float32")
+    p.add_argument("--sketch_scan_rows", type=int, default=-1,
+                   choices=(-1, 0, 1))
     p.add_argument("--approx_topk", action="store_true")
     p.add_argument("--profile_dir", type=str, default="")
     p.add_argument("--remat", action="store_true", dest="do_remat")

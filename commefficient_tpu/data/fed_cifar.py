@@ -121,10 +121,14 @@ class FedCIFAR10(FedDataset):
         self.arrays = {"image": images, "target": targets}
 
     def client_fn(self, client_id: int) -> str:
-        return os.path.join(self.dataset_dir, f"client{client_id}.npy")
+        # class-prefixed like stats_fn: CIFAR10/CIFAR100/ImageNet may share
+        # one dataset_dir and must not overwrite each other's shards
+        return os.path.join(self.dataset_dir,
+                            f"{type(self).__name__}_client{client_id}.npy")
 
     def test_fn(self) -> str:
-        return os.path.join(self.dataset_dir, "test.npz")
+        return os.path.join(self.dataset_dir,
+                            f"{type(self).__name__}_test.npz")
 
 
 class FedCIFAR100(FedCIFAR10):

@@ -86,15 +86,15 @@ class FedEMNIST(FedDataset):
             val = (vx, vy, None)
         os.makedirs(self.dataset_dir, exist_ok=True)
         tx, ty, per_client = train
-        np.savez(os.path.join(self.dataset_dir, "train.npz"),
+        np.savez(os.path.join(self.dataset_dir, "FedEMNIST_train.npz"),
                  images=tx, targets=ty)
         vx, vy = val[0], val[1]
-        np.savez(os.path.join(self.dataset_dir, "val.npz"),
+        np.savez(os.path.join(self.dataset_dir, "FedEMNIST_val.npz"),
                  images=vx, targets=vy)
         self.write_stats(per_client, len(vy))
 
     def _load_arrays(self) -> None:
-        fn = "train.npz" if self.train else "val.npz"
+        fn = "FedEMNIST_train.npz" if self.train else "FedEMNIST_val.npz"
         with np.load(os.path.join(self.dataset_dir, fn)) as d:
             images = d["images"].astype(np.float32)
             targets = d["targets"].astype(np.int64)

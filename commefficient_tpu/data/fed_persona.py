@@ -153,16 +153,15 @@ class FedPERSONA(FedDataset):
             "max_seq_len": max_seq_len,
             "max_history": max_history,
             "personality_permutations": personality_permutations,
-            "tokenizer": (type(self.tokenizer).__name__,
-                          len(self.tokenizer)),
+            "tokenizer": [type(self.tokenizer).__name__,
+                          len(self.tokenizer)],
             "corpus": ("real" if (os.path.exists(corpus_json)
                                   and not synthetic) else "synthetic"),
         }
         cfg_fn = os.path.join(self.dataset_dir, "persona_prep.json")
         if os.path.exists(cfg_fn):
             with open(cfg_fn) as f:
-                if json.load(f) != json.loads(
-                        json.dumps(self._prep_config)):
+                if json.load(f) != self._prep_config:
                     if os.path.exists(self.stats_fn()):
                         os.unlink(self.stats_fn())  # forces re-preparation
         super().__init__(*args, **kw)

@@ -126,13 +126,17 @@ def make_sketch(d: int, c: int, r: int, num_blocks: int = 1,
 
 
 def make_sketch_impl(impl: str, d: int, c: int, r: int, num_blocks: int = 1,
-                     seed: int = 42, dtype: str = "float32"):
+                     seed: int = 42, dtype: str = "float32",
+                     scan_rows: int = -1):
     """Factory over the two sketch implementations: ``"rht"`` (SRHT, MXU
     matmuls — the TPU-native default) or ``"hash"`` (count sketch, exact
-    CSVec semantics). ``dtype`` selects the rht transform compute dtype."""
+    CSVec semantics). ``dtype`` selects the rht transform compute dtype;
+    ``scan_rows``: -1 auto, 0 force batched, 1 force row-scanned."""
     if impl == "rht":
         from commefficient_tpu.ops.rht import make_rht_sketch
-        return make_rht_sketch(d, c, r, seed=seed, dtype=dtype)
+        return make_rht_sketch(d, c, r, seed=seed, dtype=dtype,
+                               scan_rows=None if scan_rows < 0
+                               else bool(scan_rows))
     if impl == "hash":
         return make_sketch(d, c, r, num_blocks, seed=seed)
     raise ValueError(f"unknown sketch_impl {impl!r} (want 'rht' or 'hash')")
