@@ -122,13 +122,22 @@ class FedCIFAR10(FedDataset):
 
     def client_fn(self, client_id: int) -> str:
         # class-prefixed like stats_fn: CIFAR10/CIFAR100/ImageNet may share
-        # one dataset_dir and must not overwrite each other's shards
-        return os.path.join(self.dataset_dir,
-                            f"{type(self).__name__}_client{client_id}.npy")
+        # one dataset_dir and must not overwrite each other's shards. A
+        # directory laid out by the reference (plain client{i}.npy,
+        # fed_cifar.py:78-84) still loads: fall back to the legacy name when
+        # the prefixed file is absent.
+        fn = os.path.join(self.dataset_dir,
+                          f"{type(self).__name__}_client{client_id}.npy")
+        legacy = os.path.join(self.dataset_dir, f"client{client_id}.npy")
+        return fn if os.path.exists(fn) or not os.path.exists(legacy) \
+            else legacy
 
     def test_fn(self) -> str:
-        return os.path.join(self.dataset_dir,
-                            f"{type(self).__name__}_test.npz")
+        fn = os.path.join(self.dataset_dir,
+                          f"{type(self).__name__}_test.npz")
+        legacy = os.path.join(self.dataset_dir, "test.npz")
+        return fn if os.path.exists(fn) or not os.path.exists(legacy) \
+            else legacy
 
 
 class FedCIFAR100(FedCIFAR10):

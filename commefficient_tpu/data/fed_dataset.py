@@ -44,8 +44,20 @@ class FedDataset:
 
         if not os.path.exists(self.stats_fn()):
             self.prepare_datasets(download=download)
-        self._load_meta()
-        self._load_arrays()
+        try:
+            self._load_meta()
+            self._load_arrays()
+        except FileNotFoundError as e:
+            # stats exist but array files are missing (partially-deleted
+            # directory): re-prepare once and reload. Loud on purpose — if
+            # the raw source is also gone, the subclass's synthetic fallback
+            # will print its own warning and the user must not mistake the
+            # result for their original data.
+            print(f"WARNING: prepared arrays missing ({e}); re-preparing "
+                  f"{type(self).__name__} under {self.dataset_dir}")
+            self.prepare_datasets(download=download)
+            self._load_meta()
+            self._load_arrays()
 
         if do_iid:
             # iid = a fixed global permutation re-dealt evenly to clients

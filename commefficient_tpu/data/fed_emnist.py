@@ -84,17 +84,23 @@ class FedEMNIST(FedDataset):
             train = _synthetic_emnist()
             vx, vy, _ = _synthetic_emnist(num_clients=4, seed=7)
             val = (vx, vy, None)
+        if val is None:
+            raise FileNotFoundError(
+                f"LEAF train split found under {self.dataset_dir} but the "
+                "test split is missing (expected test/all_data*.json)")
         os.makedirs(self.dataset_dir, exist_ok=True)
         tx, ty, per_client = train
-        np.savez(os.path.join(self.dataset_dir, "FedEMNIST_train.npz"),
+        prefix = type(self).__name__
+        np.savez(os.path.join(self.dataset_dir, f"{prefix}_train.npz"),
                  images=tx, targets=ty)
         vx, vy = val[0], val[1]
-        np.savez(os.path.join(self.dataset_dir, "FedEMNIST_val.npz"),
+        np.savez(os.path.join(self.dataset_dir, f"{prefix}_val.npz"),
                  images=vx, targets=vy)
         self.write_stats(per_client, len(vy))
 
     def _load_arrays(self) -> None:
-        fn = "FedEMNIST_train.npz" if self.train else "FedEMNIST_val.npz"
+        prefix = type(self).__name__
+        fn = f"{prefix}_train.npz" if self.train else f"{prefix}_val.npz"
         with np.load(os.path.join(self.dataset_dir, fn)) as d:
             images = d["images"].astype(np.float32)
             targets = d["targets"].astype(np.int64)

@@ -56,6 +56,10 @@ def make_batch(seed, W=8, B=4, din=6, dout=3):
     # exact-equality contract there (c >= padded d => exact round-trip)
     ("sketch", {"error_type": "virtual", "k": 5, "num_rows": 3,
                 "num_cols": 32, "sketch_impl": "rht"}),
+    ("local_topk", {"error_type": "local", "k": 5, "local_momentum": 0.9}),
+    ("fedavg", {"error_type": "none", "local_batch_size": -1,
+                "max_client_batch": 4, "fedavg_batch_size": 2,
+                "num_fedavg_epochs": 2}),
 ])
 def test_sharded_round_matches_single_device(mode, extra):
     cfg = make_cfg(mode=mode, **extra)
