@@ -18,6 +18,21 @@ Subpackages
 - ``utils``:    schedules, loggers, timers
 """
 
+import os as _os
+
+# Honor a virtual-CPU-device request (JAX_PLATFORMS=cpu +
+# --xla_force_host_platform_device_count) even when a TPU-plugin
+# sitecustomize has already set jax_platforms at the config layer, which
+# overrides the env var. Must run before any backend initializes; drivers,
+# tests, and the multichip dry-run all rely on it.
+if ("xla_force_host_platform_device_count"
+        in _os.environ.get("XLA_FLAGS", "")
+        and "cpu" in _os.environ.get("JAX_PLATFORMS", "")):
+    import jax as _jax
+
+    # honor the env var's full platform list, not a hardcoded "cpu"
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
 __version__ = "0.1.0"
 
 from commefficient_tpu.config import FedConfig  # noqa: F401

@@ -44,6 +44,8 @@ def _synthetic_cifar(num_classes: int, per_class: int, img_hw: int = 32,
 
 
 class FedCIFAR10(FedDataset):
+    expected_natural_clients = 10
+
     num_classes = 10
     _pickle_dir = "cifar-10-batches-py"
     _train_files = [f"data_batch_{i}" for i in range(1, 6)]
@@ -121,26 +123,19 @@ class FedCIFAR10(FedDataset):
         self.arrays = {"image": images, "target": targets}
 
     def client_fn(self, client_id: int) -> str:
-        # class-prefixed like stats_fn: CIFAR10/CIFAR100/ImageNet may share
-        # one dataset_dir and must not overwrite each other's shards. A
-        # directory laid out by the reference (plain client{i}.npy,
-        # fed_cifar.py:78-84) still loads: fall back to the legacy name when
-        # the prefixed file is absent.
-        fn = os.path.join(self.dataset_dir,
-                          f"{type(self).__name__}_client{client_id}.npy")
-        legacy = os.path.join(self.dataset_dir, f"client{client_id}.npy")
-        return fn if os.path.exists(fn) or not os.path.exists(legacy) \
-            else legacy
+        # class-prefixed in shared dirs; the reference's plain client{i}.npy
+        # (fed_cifar.py:78-84) when the directory is a legacy layout
+        # (FedDataset.data_fn policy)
+        return self.data_fn(f"client{client_id}.npy",
+                            f"client{client_id}.npy")
 
     def test_fn(self) -> str:
-        fn = os.path.join(self.dataset_dir,
-                          f"{type(self).__name__}_test.npz")
-        legacy = os.path.join(self.dataset_dir, "test.npz")
-        return fn if os.path.exists(fn) or not os.path.exists(legacy) \
-            else legacy
+        return self.data_fn("test.npz", "test.npz")
 
 
 class FedCIFAR100(FedCIFAR10):
+    expected_natural_clients = 100
+
     num_classes = 100
     _pickle_dir = "cifar-100-python"
     _train_files = ["train"]

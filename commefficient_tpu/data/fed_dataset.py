@@ -31,6 +31,10 @@ import numpy as np
 
 
 class FedDataset:
+    # number of natural clients this dataset always produces, or None when
+    # data-dependent; used to validate legacy-layout adoption
+    expected_natural_clients: Optional[int] = None
+
     def __init__(self, dataset_dir: str, train: bool = True,
                  do_iid: bool = False, num_clients: Optional[int] = None,
                  transform=None, download: bool = False, seed: int = 0):
@@ -42,6 +46,25 @@ class FedDataset:
         self._num_clients = num_clients
         self.transform = transform
 
+        # Legacy-layout detection, decided ONCE: a directory prepared by the
+        # reference (or pre-rename versions of this package) holds a plain
+        # stats.json + unprefixed data files and is read as-is. Anything this
+        # package prepares is written under class-prefixed names, so legacy
+        # files are never overwritten and classes sharing a dataset_dir stay
+        # isolated.
+        self._legacy_layout = (
+            not os.path.exists(self._prefixed_stats_fn())
+            and os.path.exists(os.path.join(dataset_dir, "stats.json")))
+        if self._legacy_layout and self.expected_natural_clients is not None:
+            # a legacy stats.json carries no class identity; only adopt it
+            # when its client count matches this dataset's natural partition
+            # (10 for CIFAR10, 100 for CIFAR100, ...) — otherwise it belongs
+            # to some other dataset and this class prepares its own shards
+            with open(os.path.join(dataset_dir, "stats.json")) as f:
+                n_legacy = len(json.load(f)["images_per_client"])
+            if n_legacy != self.expected_natural_clients:
+                self._legacy_layout = False
+
         if not os.path.exists(self.stats_fn()):
             self.prepare_datasets(download=download)
         try:
@@ -49,12 +72,13 @@ class FedDataset:
             self._load_arrays()
         except FileNotFoundError as e:
             # stats exist but array files are missing (partially-deleted
-            # directory): re-prepare once and reload. Loud on purpose — if
-            # the raw source is also gone, the subclass's synthetic fallback
-            # will print its own warning and the user must not mistake the
-            # result for their original data.
+            # directory): re-prepare once — under prefixed names — and
+            # reload. Loud on purpose: if the raw source is also gone, the
+            # subclass's synthetic fallback will print its own warning and
+            # the user must not mistake the result for their original data.
             print(f"WARNING: prepared arrays missing ({e}); re-preparing "
                   f"{type(self).__name__} under {self.dataset_dir}")
+            self._legacy_layout = False
             self.prepare_datasets(download=download)
             self._load_meta()
             self._load_arrays()
@@ -67,12 +91,27 @@ class FedDataset:
 
     # ---------------------------------------------------------------- meta
 
-    def stats_fn(self) -> str:
+    def _prefixed_stats_fn(self) -> str:
         # namespaced per dataset class: several datasets may share one
         # dataset_dir (the drivers' default is ./dataset for all), and one
         # dataset's stats must not make another skip its preparation
         return os.path.join(self.dataset_dir,
                             f"stats_{type(self).__name__}.json")
+
+    def stats_fn(self) -> str:
+        if getattr(self, "_legacy_layout", False):
+            return os.path.join(self.dataset_dir, "stats.json")
+        return self._prefixed_stats_fn()
+
+    def data_fn(self, name: str, legacy_name: str) -> str:
+        """Resolve a prepared-data filename: the class-prefixed name, or the
+        reference's unprefixed name when this directory was detected as a
+        coherent legacy layout at init (read path only — writes always go
+        through the prefixed name because preparation clears the flag)."""
+        if getattr(self, "_legacy_layout", False):
+            return os.path.join(self.dataset_dir, legacy_name)
+        return os.path.join(self.dataset_dir,
+                            f"{type(self).__name__}_{name}")
 
     def _load_meta(self) -> None:
         with open(self.stats_fn()) as f:
@@ -159,8 +198,11 @@ class FedDataset:
 
     def write_stats(self, images_per_client, num_val_images: int,
                     **extra) -> None:
+        # preparation always writes the prefixed layout; a directory that
+        # was read as legacy stops being legacy once re-prepared
+        self._legacy_layout = False
         os.makedirs(self.dataset_dir, exist_ok=True)
         stats = {"images_per_client": [int(x) for x in images_per_client],
                  "num_val_images": int(num_val_images), **extra}
-        with open(self.stats_fn(), "w") as f:
+        with open(self._prefixed_stats_fn(), "w") as f:
             json.dump(stats, f)

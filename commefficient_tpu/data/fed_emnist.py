@@ -99,9 +99,9 @@ class FedEMNIST(FedDataset):
         self.write_stats(per_client, len(vy))
 
     def _load_arrays(self) -> None:
-        prefix = type(self).__name__
-        fn = f"{prefix}_train.npz" if self.train else f"{prefix}_val.npz"
-        with np.load(os.path.join(self.dataset_dir, fn)) as d:
+        fn = (self.data_fn("train.npz", "train.npz") if self.train
+              else self.data_fn("val.npz", "val.npz"))
+        with np.load(fn) as d:
             images = d["images"].astype(np.float32)
             targets = d["targets"].astype(np.int64)
         self.arrays = {"image": images[..., None],  # NHWC, 1 channel
