@@ -22,7 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from commefficient_tpu import models
-from commefficient_tpu.config import FedConfig, num_classes_of_dataset, parse_args
+from commefficient_tpu.config import (FedConfig, enable_compilation_cache,
+                                      num_classes_of_dataset, parse_args)
 from commefficient_tpu.core import FedRuntime
 from commefficient_tpu.data import (
     FedSampler,
@@ -269,6 +270,7 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
 
 def main(argv=None):
     cfg = parse_args(argv, default_lr=0.4)
+    enable_compilation_cache(cfg)
     np.random.seed(cfg.seed)
     if cfg.do_test:
         # shrink sketch to smoke size (reference cv_train.py:329-336)

@@ -74,7 +74,7 @@ class FedCIFAR10(FedDataset):
             labels.append(np.asarray(d[self._label_key], dtype=np.int64))
         return np.concatenate(images), np.concatenate(labels)
 
-    def prepare_datasets(self, download: bool = False) -> None:
+    def _prepare(self, download: bool = False) -> None:
         pickled = os.path.join(self.dataset_dir, self._pickle_dir)
         if os.path.isdir(pickled) and not self._synthetic:
             train_images, train_targets = self._load_pickles(
@@ -126,11 +126,10 @@ class FedCIFAR10(FedDataset):
         # class-prefixed in shared dirs; the reference's plain client{i}.npy
         # (fed_cifar.py:78-84) when the directory is a legacy layout
         # (FedDataset.data_fn policy)
-        return self.data_fn(f"client{client_id}.npy",
-                            f"client{client_id}.npy")
+        return self.data_fn(f"client{client_id}.npy")
 
     def test_fn(self) -> str:
-        return self.data_fn("test.npz", "test.npz")
+        return self.data_fn("test.npz")
 
 
 class FedCIFAR100(FedCIFAR10):

@@ -59,9 +59,13 @@ class FedDataset:
             # a legacy stats.json carries no class identity; only adopt it
             # when its client count matches this dataset's natural partition
             # (10 for CIFAR10, 100 for CIFAR100, ...) — otherwise it belongs
-            # to some other dataset and this class prepares its own shards
-            with open(os.path.join(dataset_dir, "stats.json")) as f:
-                n_legacy = len(json.load(f)["images_per_client"])
+            # to some other dataset and this class prepares its own shards.
+            # Malformed/foreign stats never block construction.
+            try:
+                with open(os.path.join(dataset_dir, "stats.json")) as f:
+                    n_legacy = len(json.load(f)["images_per_client"])
+            except (json.JSONDecodeError, KeyError, TypeError, OSError):
+                n_legacy = -1
             if n_legacy != self.expected_natural_clients:
                 self._legacy_layout = False
 
@@ -103,13 +107,14 @@ class FedDataset:
             return os.path.join(self.dataset_dir, "stats.json")
         return self._prefixed_stats_fn()
 
-    def data_fn(self, name: str, legacy_name: str) -> str:
+    def data_fn(self, name: str) -> str:
         """Resolve a prepared-data filename: the class-prefixed name, or the
         reference's unprefixed name when this directory was detected as a
         coherent legacy layout at init (read path only — writes always go
-        through the prefixed name because preparation clears the flag)."""
+        through the prefixed name because prepare_datasets clears the
+        flag before dispatching to the subclass)."""
         if getattr(self, "_legacy_layout", False):
-            return os.path.join(self.dataset_dir, legacy_name)
+            return os.path.join(self.dataset_dir, name)
         return os.path.join(self.dataset_dir,
                             f"{type(self).__name__}_{name}")
 
@@ -167,6 +172,13 @@ class FedDataset:
         raise NotImplementedError
 
     def prepare_datasets(self, download: bool = False) -> None:
+        # preparation ALWAYS writes the class-prefixed layout — clear the
+        # legacy flag up front so data_fn never resolves a write to a
+        # legacy (reference-owned) filename
+        self._legacy_layout = False
+        self._prepare(download=download)
+
+    def _prepare(self, download: bool = False) -> None:
         raise NotImplementedError
 
     def gather(self, flat_idx: np.ndarray) -> Dict[str, np.ndarray]:
@@ -198,9 +210,6 @@ class FedDataset:
 
     def write_stats(self, images_per_client, num_val_images: int,
                     **extra) -> None:
-        # preparation always writes the prefixed layout; a directory that
-        # was read as legacy stops being legacy once re-prepared
-        self._legacy_layout = False
         os.makedirs(self.dataset_dir, exist_ok=True)
         stats = {"images_per_client": [int(x) for x in images_per_client],
                  "num_val_images": int(num_val_images), **extra}

@@ -70,7 +70,7 @@ class FedEMNIST(FedDataset):
                 per_client.append(len(y))
         return np.concatenate(images), np.concatenate(targets), per_client
 
-    def prepare_datasets(self, download: bool = False) -> None:
+    def _prepare(self, download: bool = False) -> None:
         train = None if self._synthetic else self._read_leaf("train")
         val = None if self._synthetic else self._read_leaf("test")
         if train is None:
@@ -99,8 +99,8 @@ class FedEMNIST(FedDataset):
         self.write_stats(per_client, len(vy))
 
     def _load_arrays(self) -> None:
-        fn = (self.data_fn("train.npz", "train.npz") if self.train
-              else self.data_fn("val.npz", "val.npz"))
+        fn = (self.data_fn("train.npz") if self.train
+              else self.data_fn("val.npz"))
         with np.load(fn) as d:
             images = d["images"].astype(np.float32)
             targets = d["targets"].astype(np.int64)

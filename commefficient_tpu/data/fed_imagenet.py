@@ -23,6 +23,9 @@ from commefficient_tpu.data.fed_cifar import FedCIFAR10, _synthetic_cifar
 
 
 class FedImageNet(FedCIFAR10):
+    # a legacy dir is adopted only at the standard ImageNet class count —
+    # without this override the inherited value (10) would adopt CIFAR dirs
+    expected_natural_clients = 1000
     num_classes = 1000
 
     def __init__(self, *args, image_size: int = 224,
@@ -31,7 +34,7 @@ class FedImageNet(FedCIFAR10):
         self._synthetic_num_classes = synthetic_num_classes
         super().__init__(*args, **kw)
 
-    def prepare_datasets(self, download: bool = False) -> None:
+    def _prepare(self, download: bool = False) -> None:
         train_root = os.path.join(self.dataset_dir, "train")
         if os.path.isdir(train_root):
             self._prepare_from_tree(train_root)

@@ -162,8 +162,16 @@ class FedPERSONA(FedDataset):
         if os.path.exists(cfg_fn):
             with open(cfg_fn) as f:
                 if json.load(f) != self._prep_config:
-                    if os.path.exists(self.stats_fn()):
-                        os.unlink(self.stats_fn())  # forces re-preparation
+                    # force re-preparation: remove whichever stats file
+                    # would satisfy the prepared-check — the prefixed one,
+                    # or a pre-rename plain stats.json (persona_prep.json's
+                    # presence proves this dir was persona-prepared, so the
+                    # plain file is ours to remove)
+                    for stats in (self._prefixed_stats_fn(),
+                                  os.path.join(self.dataset_dir,
+                                               "stats.json")):
+                        if os.path.exists(stats):
+                            os.unlink(stats)
         super().__init__(*args, **kw)
 
     # --------------------------------------------------------- preparation
@@ -246,7 +254,7 @@ class FedPERSONA(FedDataset):
         rows["mc_token_ids"].append(mc)
         rows["mc_label"].append(len(cands) - 1)
 
-    def prepare_datasets(self, download: bool = False) -> None:
+    def _prepare(self, download: bool = False) -> None:
         train_raw, val_raw = self._raw_corpus()
         train, per_client = self._pack_split(
             train_raw, by_personality=True,

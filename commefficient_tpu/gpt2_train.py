@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from commefficient_tpu.config import FedConfig, parse_args
+from commefficient_tpu.config import (FedConfig,
+                                      enable_compilation_cache, parse_args)
 from commefficient_tpu.core import FedRuntime
 from commefficient_tpu.cv_train import (
     build_mesh,
@@ -50,6 +51,7 @@ def build_gpt2(cfg: FedConfig, tokenizer):
 
 def main(argv=None):
     cfg = parse_args(argv, default_lr=0.16)  # reference gpt2 lr lineage
+    enable_compilation_cache(cfg)
     np.random.seed(cfg.seed)
     if cfg.do_test:
         cfg = cfg.replace(num_cols=10, num_rows=1, k=10)
