@@ -120,22 +120,30 @@ def build_datasets(cfg: FedConfig):
     return train_ds, val_ds
 
 
-def run_validation(runtime: FedRuntime, state, val_ds, cfg: FedConfig):
-    losses, accs, weights = [], [], []
+def run_validation(runtime: FedRuntime, state, val_ds, cfg: FedConfig,
+                   val_store=None):
+    """Validation sweep. With a DeviceStore, every batch is gathered on
+    device and the per-batch sums accumulate on device — exactly one host
+    fetch for the whole sweep (host<->device latency on this runtime is
+    ~170 ms per transfer, see data/device_store.py)."""
+    acc_sums = None
+    host_sums = [0.0, 0.0, 0.0]
     for idx, mask in ValSampler(len(val_ds), cfg.valid_batch_size):
-        batch = val_ds.gather(idx)
-        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        if val_store is not None:
+            batch = val_store.round_batch(idx, None)
+        else:
+            batch = val_ds.gather(idx)
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
         results, n_valid = runtime.val(state, batch, jnp.asarray(mask))
-        w = float(n_valid)
-        if w == 0:
-            continue
-        losses.append(float(results[0]) * w)
-        accs.append(float(results[1]) * w)
-        weights.append(w)
+        contrib = jnp.stack([results[0] * n_valid, results[1] * n_valid,
+                             n_valid])
+        acc_sums = contrib if acc_sums is None else acc_sums + contrib
         if cfg.do_test:
             break
-    total = max(sum(weights), 1.0)
-    return sum(losses) / total, sum(accs) / total
+    if acc_sums is not None:
+        host_sums = np.asarray(acc_sums)
+    total = max(float(host_sums[2]), 1.0)
+    return float(host_sums[0]) / total, float(host_sums[1]) / total
 
 
 def make_writer(cfg: FedConfig):
@@ -155,6 +163,23 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
           lr_mult: Optional[jax.Array] = None, loggers=(), timer=None,
           ckpt_mgr=None, start_epoch: int = 0, writer=None):
     timer = timer or Timer()
+    # device-resident data path: upload the dataset once, gather + augment
+    # each round's batch on device, accumulate metrics on device, and fetch
+    # once per epoch — a host<->device transfer costs ~170 ms latency on
+    # this runtime, so the reference's per-round stream-and-read pattern
+    # (cv_train.py:193-229) would dominate the ~50 ms round ~10x.
+    # Single-device only (the mesh path shards batches at ingest).
+    from commefficient_tpu.data.device_store import make_device_store
+    train_store = val_store = None
+    if runtime.mesh is None:
+        train_store = make_device_store(train_ds, cfg.dataset_name, True)
+        val_store = make_device_store(val_ds, cfg.dataset_name, False)
+        if train_store is not None:
+            print(f"device-resident data: train "
+                  f"{train_store.nbytes / 2**20:.0f} MiB"
+                  + (f", val {val_store.nbytes / 2**20:.0f} MiB"
+                     if val_store else ""))
+    data_key = jax.random.PRNGKey(cfg.seed ^ 0xDA7A)
     schedule = PiecewiseLinear(
         [0.0, cfg.pivot_epoch, float(cfg.num_epochs)],
         [0.0, cfg.lr_scale if cfg.lr_scale is not None else 0.4, 0.0])
@@ -174,14 +199,14 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
     summary = None
 
     if cfg.eval_before_start:
-        test_loss, test_acc = run_validation(runtime, state, val_ds, cfg)
+        test_loss, test_acc = run_validation(runtime, state, val_ds, cfg,
+                                             val_store=val_store)
         print(f"Test acc at epoch 0: {test_acc:0.4f}")
 
     for epoch in range(start_epoch, math.ceil(cfg.num_epochs)):
         epoch_fraction = (cfg.num_epochs - epoch
                           if epoch == math.ceil(cfg.num_epochs) - 1 else 1.0)
-        ep_losses, ep_accs, ep_weights = [], [], []
-        ep_download = ep_upload = 0.0
+        ep_sums = None   # device accumulator: [loss*w, acc*w, w, down, up]
         for i, rnd in enumerate(epoch_sampler(epoch)):
             # fractional final epoch (reference cv_train.py:194-196)
             if i >= spe * epoch_fraction:
@@ -190,8 +215,12 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
             lr = schedule(global_round / spe)
             lr_arr = (jnp.asarray(lr, jnp.float32) if lr_mult is None
                       else lr * lr_mult)
-            batch = train_ds.gather(rnd.idx)
-            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            if train_store is not None:
+                batch = train_store.round_batch(
+                    rnd.idx, jax.random.fold_in(data_key, global_round))
+            else:
+                batch = train_ds.gather(rnd.idx)
+                batch = {k: jnp.asarray(v) for k, v in batch.items()}
             # profiler window: steady-state rounds 2-4 of the run
             # (reference analogue: profile_helper, fed_aggregator.py:46-52)
             if cfg.profile_dir and global_round == 2:
@@ -202,32 +231,40 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                 jax.block_until_ready(state.ps_weights)
                 jax.profiler.stop_trace()
                 print(f"profiler trace written to {cfg.profile_dir}")
-            losses = np.asarray(metrics["results"][0])
-            if np.any(np.isnan(losses)):
-                print(f"LOSS OF {losses.mean()} IS NAN, TERMINATING TRAINING")
-                return state, None
-            n_valid = np.asarray(metrics["n_valid"])
-            ep_losses.append(float((losses * n_valid).sum()))
-            ep_accs.append(
-                float((np.asarray(metrics["results"][1]) * n_valid).sum()))
-            ep_weights.append(float(n_valid.sum()))
-            if cfg.track_bytes:
-                ep_download += float(
-                    np.asarray(metrics["download_bytes"]).sum())
-                ep_upload += float(np.asarray(metrics["upload_bytes"]).sum())
+            # accumulate on device: no host fetch inside the round loop
+            w = metrics["n_valid"]
+            contrib = jnp.stack([
+                (metrics["results"][0] * w).sum(),
+                (metrics["results"][1] * w).sum(),
+                w.sum(),
+                (metrics["download_bytes"].sum()
+                 if cfg.track_bytes else jnp.zeros(())),
+                (metrics["upload_bytes"].sum()
+                 if cfg.track_bytes else jnp.zeros(())),
+            ])
+            ep_sums = contrib if ep_sums is None else ep_sums + contrib
             if cfg.do_test:
                 break
 
+        sums = (np.asarray(ep_sums) if ep_sums is not None
+                else np.zeros(5))
         train_time = timer()
-        total = max(sum(ep_weights), 1.0)
-        train_loss = sum(ep_losses) / total
-        train_acc = sum(ep_accs) / total
-        download_mb = ep_download / (1024 * 1024)
-        upload_mb = ep_upload / (1024 * 1024)
+        # NaN abort, checked at the epoch boundary (the reference checks per
+        # round, cv_train.py:222-224 — per-round host fetches are what this
+        # loop exists to avoid)
+        if np.isnan(sums[0]):
+            print(f"LOSS OF {sums[0]} IS NAN, TERMINATING TRAINING")
+            return state, None
+        total = max(float(sums[2]), 1.0)
+        train_loss = float(sums[0]) / total
+        train_acc = float(sums[1]) / total
+        download_mb = float(sums[3]) / (1024 * 1024)
+        upload_mb = float(sums[4]) / (1024 * 1024)
         total_download_mb += download_mb
         total_upload_mb += upload_mb
 
-        test_loss, test_acc = run_validation(runtime, state, val_ds, cfg)
+        test_loss, test_acc = run_validation(runtime, state, val_ds, cfg,
+                                             val_store=val_store)
         test_time = timer()
 
         summary = {

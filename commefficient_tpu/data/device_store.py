@@ -1,0 +1,155 @@
+"""Device-resident dataset store: upload once, index + augment on device.
+
+Why this exists
+---------------
+The reference streams every round's batch host->GPU and reads metrics back
+per round (fed_worker.py:41, cv_train.py:193-229) — cheap over PCIe. On this
+TPU runtime a single host<->device transfer costs ~170 ms of LATENCY
+regardless of size, so a per-round upload+fetch pair dominates the 50 ms
+federated round ~10x. The TPU-native discipline (SURVEY.md §7 "hard parts":
+keep state resident, fetch only metrics) extends to the DATA: raw uint8
+arrays are uploaded once (CIFAR-10 train is 150 MB), each round's batch is
+gathered and augmented ON DEVICE from tiny resident index arrays, and the
+driver fetches nothing until the epoch ends.
+
+On-device augmentation mirrors data/transforms.py in kind (reflect-pad-4 +
+random crop + horizontal flip + per-channel normalize, the cifar10_fast
+recipe) but draws its randomness from a jax PRNG key, so augmentation draws differ from the host pipeline — irrelevant for
+training quality, and the eval path (normalize only) is exactly equal.
+
+Scope: image-classification stores (CIFAR/EMNIST-style uint8 or float
+images + int targets) and identity stores (already-tokenized persona int
+arrays). Anything else falls back to the host pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _arrays_nbytes(arrays) -> int:
+    return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+               for a in arrays.values())
+
+
+class DeviceStore:
+    """Uploads a dataset's arrays once; serves jitted round batches.
+
+    Parameters
+    ----------
+    arrays : dict of numpy arrays with a common leading flat-index axis
+        (a ``FedDataset.arrays``); uploaded verbatim (uint8 stays uint8).
+    iid_shuffle : optional global permutation (``FedDataset.iid_shuffle``) —
+        applied on device so host round indices stay the sampler's.
+    augment : "cifar_train" (pad+crop+flip+normalize), "normalize", or None.
+    mean, std : per-channel normalization constants (for the image leaf).
+    pad : crop padding (cifar10_fast uses 4).
+    """
+
+    def __init__(self, arrays: Dict[str, np.ndarray],
+                 iid_shuffle: Optional[np.ndarray] = None,
+                 augment: Optional[str] = None,
+                 mean=None, std=None, pad: int = 4):
+        self.arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
+        self.iid_shuffle = (jnp.asarray(iid_shuffle, jnp.int32)
+                            if iid_shuffle is not None else None)
+        self.augment = augment
+        self.mean = (jnp.asarray(mean, jnp.float32)
+                     if mean is not None else None)
+        self.std = jnp.asarray(std, jnp.float32) if std is not None else None
+        self.pad = pad
+        self._batch = jax.jit(self._batch_impl)
+
+    @property
+    def nbytes(self) -> int:
+        return _arrays_nbytes(self.arrays)
+
+    # ------------------------------------------------------------- internals
+
+    def _transform_images(self, img: jax.Array, rng) -> jax.Array:
+        x = img.astype(jnp.float32)
+        if img.dtype == jnp.uint8:   # raw 0..255 bytes
+            x = x / 255.0
+        if self.augment == "cifar_train":
+            *lead, H, W, C = x.shape
+            flat = x.reshape((-1, H, W, C))
+            n = flat.shape[0]
+            k1, k2, k3 = jax.random.split(rng, 3)
+            p = self.pad
+            padded = jnp.pad(flat, ((0, 0), (p, p), (p, p), (0, 0)),
+                             mode="reflect")  # matches transforms.py
+            offs = jax.random.randint(k1, (n, 2), 0, 2 * p + 1)
+
+            def crop_one(im, off):
+                return jax.lax.dynamic_slice(
+                    im, (off[0], off[1], 0), (H, W, C))
+
+            flat = jax.vmap(crop_one)(padded, offs)
+            do_flip = jax.random.bernoulli(k2, 0.5, (n,))
+            flat = jnp.where(do_flip[:, None, None, None],
+                             flat[:, :, ::-1, :], flat)
+            x = flat.reshape(x.shape)
+        if self.mean is not None:
+            x = (x - self.mean) / self.std
+        return x
+
+    def _batch_impl(self, flat_idx: jax.Array, rng) -> Dict[str, jax.Array]:
+        idx = flat_idx
+        if self.iid_shuffle is not None:
+            idx = self.iid_shuffle[idx]
+        out = {}
+        for k, a in self.arrays.items():
+            leaf = a[idx]
+            if k == "image" and self.augment is not None:
+                leaf = self._transform_images(leaf, rng)
+            out[k] = leaf
+        return out
+
+    # -------------------------------------------------------------- user API
+
+    def round_batch(self, flat_idx, rng) -> Dict[str, jax.Array]:
+        """Device batch for the given (host or device) index array; all
+        compute and memory traffic stays on device."""
+        return self._batch(jnp.asarray(flat_idx, jnp.int32), rng)
+
+
+_AUGMENT_FOR = {
+    # dataset_name -> (train_augment, normalize-constant prefix)
+    # "host": the train augmentation (e.g. FEMNIST crop/rotate) has no
+    # device equivalent yet — train stays on the host pipeline while eval
+    # still benefits from the device path
+    "CIFAR10": ("cifar_train", "CIFAR10"),
+    "CIFAR100": ("cifar_train", "CIFAR100"),
+    "EMNIST": ("host", "FEMNIST"),
+    "ImageNet": ("host", "IMAGENET"),
+    "PERSONA": (None, None),
+}
+
+
+def make_device_store(dataset, dataset_name: str, train: bool,
+                      max_bytes: int = 2 << 30) -> Optional[DeviceStore]:
+    """Build a DeviceStore for a FedDataset when its arrays fit on device
+    and the dataset's transform has a device equivalent; None => use the
+    host pipeline."""
+    from commefficient_tpu.data import transforms as T
+
+    if dataset_name not in _AUGMENT_FOR:
+        return None
+    aug, const = _AUGMENT_FOR[dataset_name]
+    if train and aug == "host":
+        return None
+    mean = getattr(T, f"{const}_MEAN", None) if const else None
+    std = getattr(T, f"{const}_STD", None) if const else None
+    if _arrays_nbytes(dataset.arrays) > max_bytes:
+        return None
+    return DeviceStore(
+        dataset.arrays,
+        iid_shuffle=(dataset.iid_shuffle
+                     if getattr(dataset, "do_iid", False) and train
+                     else None),
+        augment=(aug if train else ("normalize" if aug else None)),
+        mean=mean, std=std)

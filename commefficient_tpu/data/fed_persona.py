@@ -163,15 +163,27 @@ class FedPERSONA(FedDataset):
             with open(cfg_fn) as f:
                 if json.load(f) != self._prep_config:
                     # force re-preparation: remove whichever stats file
-                    # would satisfy the prepared-check — the prefixed one,
-                    # or a pre-rename plain stats.json (persona_prep.json's
-                    # presence proves this dir was persona-prepared, so the
-                    # plain file is ours to remove)
-                    for stats in (self._prefixed_stats_fn(),
-                                  os.path.join(self.dataset_dir,
-                                               "stats.json")):
-                        if os.path.exists(stats):
-                            os.unlink(stats)
+                    # would satisfy the prepared-check. The prefixed one is
+                    # unambiguously ours; a pre-rename plain stats.json is
+                    # removed only when it demonstrably describes the
+                    # persona npz (total item count matches) — in a shared
+                    # dir it may belong to another dataset's legacy layout.
+                    pref = self._prefixed_stats_fn()
+                    if os.path.exists(pref):
+                        os.unlink(pref)
+                    plain = os.path.join(self.dataset_dir, "stats.json")
+                    npz = os.path.join(self.dataset_dir, "persona_train.npz")
+                    if os.path.exists(plain) and os.path.exists(npz):
+                        try:
+                            with open(plain) as pf:
+                                n_stats = sum(
+                                    json.load(pf)["images_per_client"])
+                            with np.load(npz) as z:
+                                n_items = len(z["mc_label"])
+                        except Exception:
+                            n_stats, n_items = -1, -2
+                        if n_stats == n_items:
+                            os.unlink(plain)
         super().__init__(*args, **kw)
 
     # --------------------------------------------------------- preparation

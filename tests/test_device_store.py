@@ -1,0 +1,67 @@
+"""Device-resident data store (data/device_store.py): eval-path numeric
+equality with the host transforms, train-path shape/range sanity, iid
+routing, and the host-fallback gating."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.data import transforms as T
+from commefficient_tpu.data.device_store import DeviceStore, make_device_store
+
+
+def _fake_cifar(n=40):
+    rng = np.random.RandomState(0)
+    return {"image": rng.randint(0, 255, (n, 32, 32, 3), dtype=np.uint8),
+            "target": rng.randint(0, 10, n).astype(np.int64)}
+
+
+def test_eval_path_matches_host_normalize():
+    arrays = _fake_cifar()
+    store = DeviceStore(arrays, augment="normalize",
+                        mean=T.CIFAR10_MEAN, std=T.CIFAR10_STD)
+    idx = np.array([3, 7, 1])
+    got = store.round_batch(idx, None)
+    host = T.CifarEval()( {k: v[idx] for k, v in arrays.items()} )
+    np.testing.assert_allclose(np.asarray(got["image"]), host["image"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got["target"]),
+                                  arrays["target"][idx])
+
+
+def test_train_augment_shape_and_stats():
+    arrays = _fake_cifar()
+    store = DeviceStore(arrays, augment="cifar_train",
+                        mean=T.CIFAR10_MEAN, std=T.CIFAR10_STD)
+    idx = np.arange(16).reshape(2, 8)   # (W, B) round shape
+    out = store.round_batch(idx, jax.random.PRNGKey(0))
+    assert out["image"].shape == (2, 8, 32, 32, 3)
+    assert out["image"].dtype == jnp.float32
+    # normalized data: roughly centered
+    assert abs(float(out["image"].mean())) < 2.0
+    # different rng keys give different crops/flips
+    out2 = store.round_batch(idx, jax.random.PRNGKey(1))
+    assert float(jnp.abs(out["image"] - out2["image"]).max()) > 0
+
+
+def test_iid_shuffle_applied_on_device():
+    arrays = _fake_cifar()
+    perm = np.random.RandomState(1).permutation(40)
+    store = DeviceStore(arrays, iid_shuffle=perm)
+    idx = np.array([0, 5])
+    got = store.round_batch(idx, None)
+    np.testing.assert_array_equal(np.asarray(got["target"]),
+                                  arrays["target"][perm[idx]])
+
+
+def test_factory_gating(tmp_path):
+    from commefficient_tpu.data.fed_cifar import FedCIFAR10
+
+    ds = FedCIFAR10(str(tmp_path), train=True, synthetic=True)
+    assert make_device_store(ds, "CIFAR10", train=True) is not None
+    # EMNIST train augmentation has no device equivalent => host fallback
+    assert make_device_store(ds, "EMNIST", train=True) is None
+    # unknown dataset => host fallback
+    assert make_device_store(ds, "NOPE", train=True) is None
+    # too big => host fallback
+    assert make_device_store(ds, "CIFAR10", train=True, max_bytes=10) is None
