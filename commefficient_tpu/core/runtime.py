@@ -27,7 +27,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
 
 from commefficient_tpu.config import FedConfig
 from commefficient_tpu.core import client as client_lib
@@ -273,8 +274,6 @@ class FedRuntime:
                 out.n_valid
 
         if self._axis is not None:
-            from jax import shard_map
-            from jax.sharding import PartitionSpec as P
             ax = self._axis
             row = P(ax)
             in_specs = (

@@ -31,6 +31,7 @@ from commefficient_tpu.data import (
     get_dataset,
     transforms_for,
 )
+from commefficient_tpu.data.device_store import make_device_store
 from commefficient_tpu.losses import make_cv_loss
 from commefficient_tpu.utils import (
     PiecewiseLinear,
@@ -169,7 +170,6 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
     # this runtime, so the reference's per-round stream-and-read pattern
     # (cv_train.py:193-229) would dominate the ~50 ms round ~10x.
     # Single-device only (the mesh path shards batches at ingest).
-    from commefficient_tpu.data.device_store import make_device_store
     train_store = val_store = None
     if runtime.mesh is None:
         train_store = make_device_store(train_ds, cfg.dataset_name, True)

@@ -75,10 +75,10 @@ class DeviceStore:
         if img.dtype == jnp.uint8:   # raw 0..255 bytes
             x = x / 255.0
         if self.augment == "cifar_train":
-            *lead, H, W, C = x.shape
+            H, W, C = x.shape[-3:]
             flat = x.reshape((-1, H, W, C))
             n = flat.shape[0]
-            k1, k2, k3 = jax.random.split(rng, 3)
+            k1, k2 = jax.random.split(rng)
             p = self.pad
             padded = jnp.pad(flat, ((0, 0), (p, p), (p, p), (0, 0)),
                              mode="reflect")  # matches transforms.py
