@@ -128,21 +128,34 @@ class CheckpointManager:
         es = self.epochs()
         return es[-1] if es else None
 
-    def restore_latest(self, sharding=None, expect_fingerprint=None):
-        """Returns (state, meta) or (None, {}). When both the checkpoint's
-        meta and the caller carry a params fingerprint, a mismatch raises
-        instead of resuming into a scrambled flat-weight layout."""
+    def restore_latest(self, sharding=None, expect_fingerprint=None,
+                       allow_missing_fingerprint=False):
+        """Returns (state, meta) or (None, {}). When the caller carries a
+        params fingerprint, a mismatch — or a checkpoint that predates
+        fingerprinting and so carries none — raises instead of resuming into
+        a possibly scrambled flat-weight layout (a pre-fingerprint GPT-2
+        checkpoint resumed after e.g. ``scan_layers`` flipped would reorder
+        the whole ravel silently). ``allow_missing_fingerprint=True`` opts
+        back in to loading un-fingerprinted checkpoints."""
         e = self.latest()
         if e is None:
             return None, {}
         meta = load_meta(self._path(e))
         saved_fp = meta.get("params_fingerprint")
-        if (expect_fingerprint is not None and saved_fp is not None
-                and saved_fp != expect_fingerprint):
-            raise ValueError(
-                f"checkpoint {self._path(e)} was written under a different "
-                f"parameter layout (fingerprint {saved_fp} != "
-                f"{expect_fingerprint}); the flat ps_weights vector would "
-                "unravel into the wrong weights. Re-create the run or load "
-                "with the original model configuration.")
+        if expect_fingerprint is not None:
+            if saved_fp is None and not allow_missing_fingerprint:
+                raise ValueError(
+                    f"checkpoint {self._path(e)} carries no params "
+                    "fingerprint (written by an older version), so its flat "
+                    "ps_weights layout cannot be verified against the "
+                    "current model. Pass allow_missing_fingerprint=True "
+                    "(drivers: --resume_unverified) only if the model "
+                    "configuration is unchanged since it was written.")
+            if saved_fp is not None and saved_fp != expect_fingerprint:
+                raise ValueError(
+                    f"checkpoint {self._path(e)} was written under a "
+                    f"different parameter layout (fingerprint {saved_fp} != "
+                    f"{expect_fingerprint}); the flat ps_weights vector "
+                    "would unravel into the wrong weights. Re-create the "
+                    "run or load with the original model configuration.")
         return load_state(self._path(e), sharding=sharding), meta

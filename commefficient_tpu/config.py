@@ -61,6 +61,9 @@ class FedConfig:
     # exact mid-run resume (see checkpoint.py)
     checkpoint_every: int = 0     # epochs between mid-run checkpoints; 0=off
     do_resume: bool = False
+    # opt-in to resuming checkpoints written before params fingerprinting
+    # existed (their flat-weight layout cannot be verified; see checkpoint.py)
+    resume_unverified: bool = False
     finetune_path: str = "./finetune"
     finetuned_from: Optional[str] = None
     do_batchnorm: bool = False
@@ -242,6 +245,7 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--checkpoint_path", type=str, default="./checkpoint")
     p.add_argument("--checkpoint_every", type=int, default=0)
     p.add_argument("--resume", action="store_true", dest="do_resume")
+    p.add_argument("--resume_unverified", action="store_true")
     p.add_argument("--finetune_path", type=str, default="./finetune")
     p.add_argument("--finetuned_from", type=str, choices=list(FED_DATASETS))
     p.add_argument("--num_results_train", type=int, default=2)

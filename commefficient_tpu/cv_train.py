@@ -99,7 +99,8 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
     mgr.default_meta = {"params_fingerprint": fp}
     if cfg.do_resume:
         restored, meta = mgr.restore_latest(
-            sharding=runtime._state_sharding, expect_fingerprint=fp)
+            sharding=runtime._state_sharding, expect_fingerprint=fp,
+            allow_missing_fingerprint=cfg.resume_unverified)
         if restored is not None:
             start = int(meta.get("epoch", 0))
             print(f"resumed from epoch {start}")

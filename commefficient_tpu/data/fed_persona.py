@@ -158,32 +158,74 @@ class FedPERSONA(FedDataset):
             "corpus": ("real" if (os.path.exists(corpus_json)
                                   and not synthetic) else "synthetic"),
         }
-        cfg_fn = os.path.join(self.dataset_dir, "persona_prep.json")
-        if os.path.exists(cfg_fn):
-            with open(cfg_fn) as f:
-                if json.load(f) != self._prep_config:
-                    # force re-preparation: remove whichever stats file
-                    # would satisfy the prepared-check. The prefixed one is
-                    # unambiguously ours; a pre-rename plain stats.json is
-                    # removed only when it demonstrably describes the
-                    # persona npz (total item count matches) — in a shared
-                    # dir it may belong to another dataset's legacy layout.
-                    pref = self._prefixed_stats_fn()
-                    if os.path.exists(pref):
-                        os.unlink(pref)
-                    plain = os.path.join(self.dataset_dir, "stats.json")
-                    npz = os.path.join(self.dataset_dir, "persona_train.npz")
-                    if os.path.exists(plain) and os.path.exists(npz):
-                        try:
-                            with open(plain) as pf:
-                                n_stats = sum(
-                                    json.load(pf)["images_per_client"])
-                            with np.load(npz) as z:
-                                n_items = len(z["mc_label"])
-                        except Exception:
-                            n_stats, n_items = -1, -2
-                        if n_stats == n_items:
-                            os.unlink(plain)
+        # prep-config staleness check. The cfg sidecar lives under the
+        # class-prefixed name (write policy of fed_dataset.data_fn); a plain
+        # persona_prep.json is read as a legacy layout's sidecar. A cache
+        # with NO sidecar but an existing packed npz was written by a
+        # pre-sidecar version whose packing semantics differ (no history
+        # truncation, no permutations) — it can never match the current
+        # config, so it is stale by definition and must re-prepare rather
+        # than be silently adopted.
+        # data_fn resolves to the prefixed name here (_legacy_layout is not
+        # set yet), which is exactly the write-policy name _prepare will use
+        cfg_pref = self.data_fn("persona_prep.json")
+        cfg_legacy = os.path.join(self.dataset_dir, "persona_prep.json")
+        npz_pref = self.data_fn("persona_train.npz")
+        npz_legacy = os.path.join(self.dataset_dir, "persona_train.npz")
+        val_legacy = os.path.join(self.dataset_dir, "persona_val.npz")
+        saved_cfg = cfg_src = None
+        for fn in (cfg_pref, cfg_legacy):
+            if os.path.exists(fn):
+                with open(fn) as f:
+                    saved_cfg = json.load(f)
+                cfg_src = fn
+                break
+        have_pack = os.path.exists(npz_pref) or os.path.exists(npz_legacy)
+        stale = (saved_cfg != self._prep_config if saved_cfg is not None
+                 else have_pack)
+        if (not stale and cfg_src == cfg_legacy
+                and os.path.exists(npz_legacy)
+                and not os.path.exists(npz_pref)
+                and os.path.exists(self._prefixed_stats_fn())):
+            # mixed layout from the immediately previous version (prefixed
+            # stats via write_stats, but unprefixed pack + sidecar): the
+            # pack matches this config, so adopt it by renaming into the
+            # prefixed scheme instead of re-tokenizing the whole corpus
+            os.rename(npz_legacy, npz_pref)
+            if os.path.exists(val_legacy):
+                os.rename(val_legacy, self.data_fn("persona_val.npz"))
+            os.rename(cfg_legacy, cfg_pref)
+        if stale:
+            # force re-preparation: remove whichever stats file would
+            # satisfy the prepared-check. The prefixed one is unambiguously
+            # ours; a pre-rename plain stats.json is removed only when it
+            # demonstrably describes the persona npz (total item count
+            # matches) — in a shared dir it may belong to another dataset's
+            # legacy layout.
+            pref = self._prefixed_stats_fn()
+            if os.path.exists(pref):
+                os.unlink(pref)
+            plain = os.path.join(self.dataset_dir, "stats.json")
+            if os.path.exists(plain) and os.path.exists(npz_legacy):
+                try:
+                    with open(plain) as pf:
+                        n_stats = sum(json.load(pf)["images_per_client"])
+                    with np.load(npz_legacy) as z:
+                        n_items = len(z["mc_label"])
+                except Exception:
+                    n_stats, n_items = -1, -2
+                if n_stats == n_items:
+                    os.unlink(plain)
+            # a stale pack must never be adoptable (silent adoption is the
+            # bug this block closes): persona_*.npz / persona_prep.json are
+            # only ever written by this package, so removing them is safe
+            # even when the plain stats.json (possibly another dataset's)
+            # has to stay — without this, a foreign stats.json would make
+            # the base class adopt the stale unprefixed pack as a legacy
+            # layout with mismatched metadata
+            for fn in (npz_legacy, val_legacy, cfg_legacy):
+                if os.path.exists(fn):
+                    os.unlink(fn)
         super().__init__(*args, **kw)
 
     # --------------------------------------------------------- preparation
@@ -275,16 +317,18 @@ class FedPERSONA(FedDataset):
         # personalities only)
         val, _ = self._pack_split(val_raw, by_personality=True)
         os.makedirs(self.dataset_dir, exist_ok=True)
-        np.savez(os.path.join(self.dataset_dir, "persona_train.npz"), **train)
-        np.savez(os.path.join(self.dataset_dir, "persona_val.npz"), **val)
-        with open(os.path.join(self.dataset_dir, "persona_prep.json"),
-                  "w") as f:
+        # class-prefixed writes via data_fn (prepare_datasets cleared the
+        # legacy flag, so these resolve to FedPERSONA_-prefixed names — the
+        # write policy fed_dataset.py:110-119 establishes for every dataset)
+        np.savez(self.data_fn("persona_train.npz"), **train)
+        np.savez(self.data_fn("persona_val.npz"), **val)
+        with open(self.data_fn("persona_prep.json"), "w") as f:
             json.dump(self._prep_config, f)
         self.write_stats(per_client, len(val["mc_label"]))
 
     def _load_arrays(self) -> None:
         fn = "persona_train.npz" if self.train else "persona_val.npz"
-        with np.load(os.path.join(self.dataset_dir, fn)) as d:
+        with np.load(self.data_fn(fn)) as d:
             self.arrays = {k: d[k] for k in d.files}
 
 

@@ -94,8 +94,17 @@ def test_fingerprint_guard(tmp_path):
     # different layout: refused
     with pytest.raises(ValueError, match="different parameter layout"):
         mgr.restore_latest(expect_fingerprint=fp_b)
-    # legacy checkpoints without a fingerprint still load
+    # legacy checkpoints without a fingerprint are REFUSED by default (the
+    # layout cannot be verified, and the guard exists precisely for
+    # pre-fingerprint checkpoints) ...
     mgr2 = CheckpointManager(str(tmp_path / "fp2"))
     mgr2.save(state, epoch=0)
-    restored, _ = mgr2.restore_latest(expect_fingerprint=fp_a)
+    with pytest.raises(ValueError, match="no params fingerprint"):
+        mgr2.restore_latest(expect_fingerprint=fp_a)
+    # ... unless the caller explicitly opts in (--resume_unverified)
+    restored, _ = mgr2.restore_latest(expect_fingerprint=fp_a,
+                                      allow_missing_fingerprint=True)
+    assert restored is not None
+    # callers that pass no expectation are unaffected
+    restored, _ = mgr2.restore_latest()
     assert restored is not None

@@ -106,6 +106,66 @@ def test_fed_persona_synthetic(tmp_path):
     val = FedPERSONA(str(tmp_path), train=False, synthetic=True,
                      max_seq_len=48)
     assert len(val) > 0
+    # write policy: every persona artifact is class-prefixed
+    # (fed_dataset.py data_fn write policy; VERDICT r1 weak #6)
+    import os
+    for fn in ("persona_train.npz", "persona_val.npz",
+               "persona_prep.json"):
+        assert os.path.exists(str(tmp_path / f"FedPERSONA_{fn}")), fn
+        assert not os.path.exists(str(tmp_path / fn)), fn
+
+
+def test_persona_legacy_cache_invalidation(tmp_path):
+    """A packed cache with no prep-config sidecar predates the sidecar and
+    its packing semantics — it must be re-prepared, not silently adopted
+    (ADVICE r1 low #3)."""
+    import os
+
+    ds = FedPERSONA(str(tmp_path), synthetic=True, max_seq_len=48)
+    n_items = len(ds)
+    # forge a pre-sidecar legacy layout: unprefixed npz + plain stats.json,
+    # no persona_prep.json anywhere
+    for fn in ("persona_train.npz", "persona_val.npz"):
+        os.rename(str(tmp_path / f"FedPERSONA_{fn}"), str(tmp_path / fn))
+    os.rename(str(tmp_path / "stats_FedPERSONA.json"),
+              str(tmp_path / "stats.json"))
+    os.unlink(str(tmp_path / "FedPERSONA_persona_prep.json"))
+    # sanity: a legacy layout WITH a matching sidecar is adopted as-is
+    import json as _json
+    with open(str(tmp_path / "persona_prep.json"), "w") as f:
+        _json.dump(ds._prep_config, f)
+    adopted = FedPERSONA(str(tmp_path), synthetic=True, max_seq_len=48)
+    assert adopted._legacy_layout
+    os.unlink(str(tmp_path / "persona_prep.json"))
+    # no sidecar: stale by definition -> re-prepared under prefixed names
+    fresh = FedPERSONA(str(tmp_path), synthetic=True, max_seq_len=48)
+    assert not fresh._legacy_layout
+    assert len(fresh) == n_items
+    assert os.path.exists(str(tmp_path / "FedPERSONA_persona_train.npz"))
+    # and the stale unprefixed pack was removed, not left adoptable
+    assert not os.path.exists(str(tmp_path / "persona_train.npz"))
+
+
+def test_persona_mixed_layout_adoption(tmp_path):
+    """The immediately previous package version wrote prefixed stats but
+    unprefixed npz + sidecar; a matching pack is adopted by rename instead
+    of re-tokenizing the corpus."""
+    import os
+
+    ds = FedPERSONA(str(tmp_path), synthetic=True, max_seq_len=48)
+    n_items = len(ds)
+    # forge the mixed layout: prefixed stats stays, pack+sidecar unprefixed
+    for fn in ("persona_train.npz", "persona_val.npz",
+               "persona_prep.json"):
+        os.rename(str(tmp_path / f"FedPERSONA_{fn}"), str(tmp_path / fn))
+    # tag the pack so we can prove it was adopted, not regenerated
+    mtime = os.path.getmtime(str(tmp_path / "persona_train.npz"))
+    adopted = FedPERSONA(str(tmp_path), synthetic=True, max_seq_len=48)
+    assert len(adopted) == n_items
+    pref = str(tmp_path / "FedPERSONA_persona_train.npz")
+    assert os.path.exists(pref)
+    assert os.path.getmtime(pref) == mtime        # renamed, not re-packed
+    assert not os.path.exists(str(tmp_path / "persona_train.npz"))
 
 
 def test_lm_head_variant():
