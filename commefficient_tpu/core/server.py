@@ -46,6 +46,21 @@ def validate_mode_combo(cfg: FedConfig) -> None:
     """
     m, e = cfg.mode, cfg.error_type
     if m == "sketch":
+        if (cfg.sketch_impl == "rht" and cfg.grad_size
+                and cfg.num_rows * cfg.num_cols < cfg.grad_size):
+            # measured (tests/test_learning.py sketch-regime study): at
+            # r*c < d the SRHT top-k-over-JL-estimates update EXPANDS the
+            # accumulated error instead of contracting it and training
+            # diverges within tens of rounds — on every topology, with
+            # either error-feedback rule. The count-sketch cell-zeroing
+            # rule (circ/hash impls) dissipates k/c of the table's error
+            # mass per round and is stable; circ is the default.
+            print("WARNING: sketch_impl=rht with r*c "
+                  f"({cfg.num_rows * cfg.num_cols}) < grad_size "
+                  f"({cfg.grad_size}) diverges under error feedback in "
+                  "practice; use sketch_impl=circ (default) or hash for "
+                  "compressing configurations (rht is safe only when "
+                  "r*c >= d)")
         if e != "virtual":
             raise ValueError(
                 "mode=sketch requires error_type=virtual (FetchSGD). "

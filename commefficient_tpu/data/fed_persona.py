@@ -218,14 +218,15 @@ class FedPERSONA(FedDataset):
                     os.unlink(plain)
             # a stale pack must never be adoptable (silent adoption is the
             # bug this block closes): persona_*.npz / persona_prep.json are
-            # only ever written by this package, so removing them is safe
-            # even when the plain stats.json (possibly another dataset's)
-            # has to stay — without this, a foreign stats.json would make
-            # the base class adopt the stale unprefixed pack as a legacy
-            # layout with mismatched metadata
+            # only ever written by this package, so renaming them out of
+            # the adoption path is safe even when the plain stats.json
+            # (possibly another dataset's) has to stay. Rename, don't
+            # delete: if re-preparation falls back to synthetic data (the
+            # real corpus json may be gone), the original pack is still
+            # recoverable from the .stale files.
             for fn in (npz_legacy, val_legacy, cfg_legacy):
                 if os.path.exists(fn):
-                    os.unlink(fn)
+                    os.replace(fn, fn + ".stale")
         super().__init__(*args, **kw)
 
     # --------------------------------------------------------- preparation

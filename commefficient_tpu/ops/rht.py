@@ -14,8 +14,24 @@ matmuls: no scatter, no gather, no sort anywhere. ~15 ms where the hash
 sketch needs ~500 ms. It replaces the same external ``csvec.CSVec``
 dependency (reference call sites CommEfficient/fed_worker.py:312-320,
 fed_aggregator.py:464-467, 584-595) with different — strictly
-TPU-friendlier — internals. The hash impl remains available
-(``sketch_impl="hash"``) as the exact CSVec-semantics path.
+TPU-friendlier — internals.
+
+Regime of validity (IMPORTANT)
+------------------------------
+Safe only near the lossless regime r*c >= d. At real compression ratios
+(r*c << d) FetchSGD error feedback DIVERGES with this sketch — measured
+in tests/test_learning.py's sketch-regime study, on every topology and
+with either error-feedback rule: SRHT decode noise is spread uniformly
+(~||v||/sqrt(c) per coordinate), so top-k over the estimates stops being
+a contraction of the accumulated error once the un-transmitted mass
+dominates, and the error feedback loop explodes within tens of rounds.
+The count-sketch cell-zeroing rule dissipates k/c of the table's error
+mass every round and is stable — the default impl is the circulant count
+sketch (``sketch_impl="circ"``, ops/circulant.py: cell semantics without
+the scatter/gather cost), with ``"hash"`` as the exact-CSVec-semantics
+variant; use rht for speed only when the sketch is sized
+lossless-or-near (e.g. download-side compression, diagnostics,
+r*c >= d configs).
 
 Construction
 ------------

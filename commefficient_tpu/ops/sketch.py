@@ -128,10 +128,12 @@ def make_sketch(d: int, c: int, r: int, num_blocks: int = 1,
 def make_sketch_impl(impl: str, d: int, c: int, r: int, num_blocks: int = 1,
                      seed: int = 42, dtype: str = "float32",
                      scan_rows: int = -1):
-    """Factory over the two sketch implementations: ``"rht"`` (SRHT, MXU
-    matmuls — the TPU-native default) or ``"hash"`` (count sketch, exact
-    CSVec semantics). ``dtype`` selects the rht transform compute dtype;
-    ``scan_rows``: -1 auto, 0 force batched, 1 force row-scanned."""
+    """Factory over the three sketch implementations: ``"circ"`` (circulant
+    count sketch — stable cell-zeroing semantics AND scatter-free TPU speed,
+    the default), ``"hash"`` (count sketch, exact CSVec semantics) or
+    ``"rht"`` (SRHT, MXU matmuls; lossless-regime only — see ops/rht.py).
+    ``dtype`` selects the rht transform compute dtype; ``scan_rows``: -1
+    auto, 0 force batched, 1 force row-scanned."""
     if impl == "rht":
         from commefficient_tpu.ops.rht import make_rht_sketch
         return make_rht_sketch(d, c, r, seed=seed, dtype=dtype,
@@ -139,7 +141,11 @@ def make_sketch_impl(impl: str, d: int, c: int, r: int, num_blocks: int = 1,
                                else bool(scan_rows))
     if impl == "hash":
         return make_sketch(d, c, r, num_blocks, seed=seed)
-    raise ValueError(f"unknown sketch_impl {impl!r} (want 'rht' or 'hash')")
+    if impl == "circ":
+        from commefficient_tpu.ops.circulant import make_circulant_sketch
+        return make_circulant_sketch(d, c, r, num_blocks, seed=seed)
+    raise ValueError(
+        f"unknown sketch_impl {impl!r} (want 'circ', 'hash' or 'rht')")
 
 
 def _mix32(h: jax.Array) -> jax.Array:

@@ -175,3 +175,86 @@ class TestSketch:
         counts = np.bincount(np.asarray(buckets[0]), minlength=C)
         # expected D/C per bucket = 2.5; max shouldn't explode
         assert counts.max() < 15
+
+
+@pytest.fixture(scope="module")
+def ccs():
+    from commefficient_tpu.ops.circulant import make_circulant_sketch
+    return make_circulant_sketch(d=D, c=C, r=R, num_blocks=2, seed=7)
+
+
+class TestCirculantSketch:
+    """Circulant count sketch (ops/circulant.py): same property surface as
+    the hash impl — it must be a drop-in (r, c) linear sketch with
+    count-sketch estimator guarantees — plus the static-roll layout rules."""
+
+    def test_linearity(self, ccs):
+        rng = np.random.RandomState(2)
+        a = jnp.asarray(rng.randn(D).astype(np.float32))
+        b = jnp.asarray(rng.randn(D).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(ccs.encode(a) + ccs.encode(b)),
+            np.asarray(ccs.encode(a + b)), atol=1e-4)
+
+    def test_block_invariance(self):
+        """num_blocks is a decode-memory knob only — table and decode must
+        not depend on it."""
+        from commefficient_tpu.ops.circulant import make_circulant_sketch
+        rng = np.random.RandomState(3)
+        v = jnp.asarray(rng.randn(D).astype(np.float32))
+        t1 = make_circulant_sketch(D, C, R, num_blocks=1, seed=7)
+        t3 = make_circulant_sketch(D, C, R, num_blocks=3, seed=7)
+        np.testing.assert_allclose(np.asarray(t1.encode(v)),
+                                   np.asarray(t3.encode(v)), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(t1.decode(t1.encode(v))),
+                                   np.asarray(t3.decode(t3.encode(v))),
+                                   atol=1e-4)
+
+    def test_heavy_hitter_recovery(self, ccs):
+        rng = np.random.RandomState(4)
+        k = 10
+        v = rng.randn(D).astype(np.float32) * 0.01
+        spikes = rng.choice(D, k, replace=False)
+        v[spikes] = np.sign(rng.randn(k)) * (10.0 + rng.rand(k))
+        rec = np.asarray(ccs.unsketch(ccs.encode(jnp.asarray(v)), k))
+        assert set(np.nonzero(rec)[0]) == set(spikes)
+        np.testing.assert_allclose(rec[spikes], v[spikes], rtol=0.05,
+                                   atol=0.1)
+
+    def test_lossless_limit_exact(self):
+        """c >= d => single block, rolls are invertible: decode is EXACT."""
+        from commefficient_tpu.ops.circulant import make_circulant_sketch
+        d = 200
+        cs_big = make_circulant_sketch(d=d, c=256, r=3, seed=11)
+        rng = np.random.RandomState(5)
+        v = jnp.asarray(rng.randn(d).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(cs_big.decode(cs_big.encode(v))), np.asarray(v),
+            atol=1e-5)
+
+    def test_encode_at_matches_dense(self, ccs):
+        """encode_at on a k-sparse vector == full encode (the server's
+        error-feedback re-encode contract, reference
+        fed_aggregator.py:593-595)."""
+        rng = np.random.RandomState(6)
+        idx = jnp.asarray(rng.choice(D, 50, replace=False))
+        v = jnp.zeros((D,), jnp.float32).at[idx].set(
+            jnp.asarray(rng.randn(50), jnp.float32))
+        np.testing.assert_allclose(np.asarray(ccs.encode_at(v, idx)),
+                                   np.asarray(ccs.encode(v)), atol=1e-4)
+
+    def test_l2_estimate(self, ccs):
+        rng = np.random.RandomState(6)
+        v = jnp.asarray(rng.randn(D).astype(np.float32))
+        est = float(ccs.l2estimate(ccs.encode(v)))
+        true = float(jnp.linalg.norm(v))
+        assert abs(est - true) / true < 0.15
+
+    def test_jit_with_sketch_argument(self, ccs):
+        """The runtime threads the sketch as a jit ARGUMENT; the static
+        shifts live in pytree aux data, so this must trace cleanly."""
+        rng = np.random.RandomState(8)
+        v = jnp.asarray(rng.randn(D).astype(np.float32))
+        t = jax.jit(lambda cs, x: cs.encode(x))(ccs, v)
+        np.testing.assert_allclose(np.asarray(t), np.asarray(ccs.encode(v)),
+                                   atol=1e-4)
