@@ -67,13 +67,35 @@ def save_state(path: str, state: FedState,
     return path + ".npz"
 
 
-def load_state(path: str, sharding=None) -> FedState:
+def load_state(path: str, sharding=None,
+               d_pad: Optional[int] = None) -> FedState:
     """Rebuild a FedState; optional sharding pytree (from
-    ``FedRuntime._state_sharding``) places arrays sharded on load."""
+    ``FedRuntime._state_sharding``) places arrays sharded on load.
+
+    Migrations for checkpoints written by earlier versions / other
+    topologies: a missing ``nan_round`` defaults to -1, and when ``d_pad``
+    (the restoring runtime's padded dense length) is given, 1-D dense
+    server leaves are zero-padded or sliced to it — so a single-device
+    checkpoint resumes on a mesh and vice versa."""
     with np.load(path + ".npz") as z:
-        kw = {name: (jax.numpy.asarray(z[name]) if name in z.files else None)
+        kw = {name: (np.asarray(z[name]) if name in z.files else None)
               for name in _FIELDS}
-    state = FedState(**kw)
+    if kw.get("nan_round") is None:
+        kw["nan_round"] = np.full((), -1, np.int32)
+    if d_pad is not None:
+        for name in ("ps_weights", "Vvelocity", "Verror",
+                     "coord_last_update"):
+            arr = kw.get(name)
+            if arr is not None and arr.ndim == 1 and arr.shape[0] != d_pad:
+                if arr.shape[0] < d_pad:
+                    fill = -1 if name == "coord_last_update" else 0
+                    arr = np.pad(arr, (0, d_pad - arr.shape[0]),
+                                 constant_values=fill)
+                else:
+                    arr = arr[:d_pad]
+                kw[name] = arr
+    state = FedState(**{k: (jax.numpy.asarray(v) if v is not None else None)
+                        for k, v in kw.items()})
     if sharding is not None:
         state = jax.device_put(state, sharding)
     return state
@@ -129,7 +151,7 @@ class CheckpointManager:
         return es[-1] if es else None
 
     def restore_latest(self, sharding=None, expect_fingerprint=None,
-                       allow_missing_fingerprint=False):
+                       allow_missing_fingerprint=False, d_pad=None):
         """Returns (state, meta) or (None, {}). When the caller carries a
         params fingerprint, a mismatch — or a checkpoint that predates
         fingerprinting and so carries none — raises instead of resuming into
@@ -158,4 +180,5 @@ class CheckpointManager:
                     f"{expect_fingerprint}); the flat ps_weights vector "
                     "would unravel into the wrong weights. Re-create the "
                     "run or load with the original model configuration.")
-        return load_state(self._path(e), sharding=sharding), meta
+        return load_state(self._path(e), sharding=sharding,
+                          d_pad=d_pad), meta

@@ -174,4 +174,4 @@ class FedModel:
 
     def save_pretrained(self, path: str) -> None:
         np.savez(path if path.endswith(".npz") else path + ".npz",
-                 ps_weights=np.asarray(self.state.ps_weights))
+                 ps_weights=np.asarray(self.runtime.flat_weights(self.state)))

@@ -74,8 +74,21 @@ class FedRuntime:
             self.shardings = FedShardings(mesh)
             n_dev = mesh.shape[self.shardings.axis]
             self.num_clients = -(-self.num_clients // n_dev) * n_dev
+            # pad the dense federated vector too, so the SERVER state
+            # (ps_weights, dense Vvelocity/Verror, coord_last_update) always
+            # shards evenly over the mesh: the dense-mode client sum arrives
+            # by reduce_scatter (each device owns d_pad/n coordinates of the
+            # summed gradient), the elementwise server math runs sharded,
+            # and XLA all-gathers only where globality is required (the
+            # top-k select, and the per-round weight broadcast every client
+            # needs anyway). Without this, any d not divisible by the mesh
+            # fell back to a fully-replicated (d,) all-reduce — at GPT-2
+            # scale a 500 MB collective where a shard-sized one suffices
+            # (ref aggregation: fed_aggregator.py:326-332, 446-458).
+            self.d_pad = -(-cfg.grad_size // n_dev) * n_dev
         else:
             self.shardings = None
+            self.d_pad = cfg.grad_size
         self._axis = self.shardings.axis if self.shardings else None
         self.batch_size = (cfg.local_batch_size if cfg.local_batch_size > 0
                            else cfg.max_client_batch)
@@ -160,13 +173,18 @@ class FedRuntime:
 
     def _make_state(self, seed, initial_weights) -> FedState:
         cfg = self.cfg
-        # dense pre-image states for the single-device SRHT path (see
-        # __init__); sketch-table shape otherwise
-        tx = ((cfg.grad_size,) if self._dense_preimage
-              else cfg.transmitted_shape)
+        # Server-side transmitted-space state lives at the mesh-padded
+        # length so it shards evenly (see __init__); per-client rows are
+        # CLIENT-side quantities and stay at the true d (they are sharded
+        # over the clients axis, not the weight axis). Sketch-table shapes
+        # are unaffected. Dense pre-image states for the single-device SRHT
+        # path (see __init__) are dense too.
+        dense = self._dense_preimage or cfg.mode != "sketch"
+        server_tx = (self.d_pad,) if dense else cfg.transmitted_shape
+        client_tx = (cfg.grad_size,) if dense else cfg.transmitted_shape
         d = cfg.grad_size
         n = self.num_clients
-        zeros_tx = jnp.zeros(tx, jnp.float32)
+        zeros_tx = jnp.zeros(server_tx, jnp.float32)
 
         def maybe(shape, cond):
             return jnp.zeros(shape, jnp.float32) if cond else None
@@ -174,21 +192,24 @@ class FedRuntime:
         return FedState(
             # copy: the round step donates its input state, and the shared
             # self.initial_weights buffer must survive repeated init_state()
-            ps_weights=jnp.array(initial_weights, copy=True),
+            ps_weights=jnp.pad(jnp.asarray(initial_weights),
+                               (0, self.d_pad - d)),
             Vvelocity=zeros_tx,
             Verror=jnp.zeros_like(zeros_tx),
             step=jnp.zeros((), jnp.int32),
             rng=jax.random.PRNGKey(seed),
-            client_velocities=maybe((n,) + tx, cfg.needs_client_velocities),
-            client_errors=maybe((n,) + tx, cfg.needs_client_errors),
+            client_velocities=maybe((n,) + client_tx,
+                                    cfg.needs_client_velocities),
+            client_errors=maybe((n,) + client_tx, cfg.needs_client_errors),
             # every client starts with the initial PS weights
             # (reference fed_aggregator.py:105-111)
             client_weights=(jnp.broadcast_to(initial_weights, (n, d))
                             if cfg.do_topk_down else None),
-            coord_last_update=(jnp.full((d,), -1, jnp.int32)
+            coord_last_update=(jnp.full((self.d_pad,), -1, jnp.int32)
                                if cfg.track_bytes else None),
             client_last_round=(jnp.zeros((n,), jnp.int32)
                                if cfg.track_bytes else None),
+            nan_round=jnp.full((), -1, jnp.int32),
         )
 
     # ------------------------------------------------------------- round step
@@ -221,9 +242,10 @@ class FedRuntime:
         client_weights = state.client_weights
         if cfg.do_topk_down:
             stale = state.client_weights[client_ids]
+            ps_true = state.ps_weights[: cfg.grad_size]
             used_weights = jax.vmap(
                 lambda w: client_lib.topk_down_weights(
-                    cfg, state.ps_weights, w))(stale)
+                    cfg, ps_true, w))(stale)
             client_weights = state.client_weights.at[client_ids].set(
                 used_weights)
             params_axis = 0
@@ -250,25 +272,48 @@ class FedRuntime:
 
         def client_block(used_weights, batch, mask, vel_rows, err_rows,
                          client_rngs, lr, cs):
+            if params_axis is None:
+                # clients read the (padded, possibly sharded) PS weights;
+                # the slice back to true d happens here, inside the block,
+                # where the weights are already a full local copy
+                used = used_weights[: cfg.grad_size]
+            else:
+                used = used_weights
             if cfg.mode == "fedavg":
+                # fedavg applies the LR on the CLIENT against true-d
+                # weights; a per-param vector arrives mesh-padded for the
+                # server consumers, so slice it back here
+                lr_c = lr[: cfg.grad_size] if lr.ndim == 1 else lr
                 out = jax.vmap(
                     self._client_fn,
                     in_axes=(params_axis, 0, 0, None, 0))(
-                        used_weights, batch, mask, lr, client_rngs)
+                        used, batch, mask, lr_c, client_rngs)
             else:
                 out = jax.vmap(
                     self._client_fn,
                     in_axes=(params_axis, 0, 0,
                              0 if has_vel else None,
                              0 if has_err else None, 0, None))(
-                        used_weights, batch, mask, vel_rows, err_rows,
+                        used, batch, mask, vel_rows, err_rows,
                         client_rngs, cs)
             agg = out.transmit.sum(axis=0)
             if self._defer_encode and not self._dense_preimage:
                 agg = cs.encode(agg)
             n_total = out.n_valid.sum()
             if self._axis is not None:
-                agg = lax.psum(agg, self._axis)
+                if agg.ndim == 1:
+                    # dense modes: reduce_scatter the client sum so each
+                    # device receives only its d_pad/n shard of the summed
+                    # gradient — the server update then runs fully sharded.
+                    # (The ICI analogue of encode-before-reduce for dense
+                    # payloads; reference reduce: fed_aggregator.py:326-332)
+                    agg = lax.psum_scatter(
+                        jnp.pad(agg, (0, self.d_pad - cfg.grad_size)),
+                        self._axis, scatter_dimension=0, tiled=True)
+                else:
+                    # sketch tables are already the compressed payload: one
+                    # table-sized psum (analogue of encode-before-NCCL)
+                    agg = lax.psum(agg, self._axis)
                 n_total = lax.psum(n_total, self._axis)
             return agg, n_total, out.velocity, out.error, out.results, \
                 out.n_valid
@@ -287,7 +332,11 @@ class FedRuntime:
                 jax.tree.map(lambda _: P(), cs),
             )
             out_specs = (
-                P(), P(),
+                # dense modes leave the block as a reduce_scattered shard
+                # of the summed gradient; sketch leaves as a replicated
+                # (psum'd) table
+                row if cfg.mode != "sketch" else P(),
+                P(),
                 row if (cfg.mode != "fedavg" and has_vel) else None,
                 row if (cfg.mode != "fedavg" and has_err) else None,
                 tuple(row for _ in range(cfg.num_results_train)),
@@ -313,6 +362,17 @@ class FedRuntime:
             cfg, agg, state.Vvelocity, state.Verror, server_lr,
             cs=cs, dp_rng=server_rng,
             dense_preimage=self._dense_preimage)
+        if self.d_pad != cfg.grad_size:
+            if update.shape[0] == cfg.grad_size:
+                # sketch decode produces a true-d update; pad to the
+                # server's sharded length
+                update = jnp.pad(update, (0, self.d_pad - cfg.grad_size))
+            else:
+                # keep the padding coordinates exactly zero (server-side DP
+                # noise would otherwise drift them and pollute the
+                # changed-coordinate byte accounting)
+                update = jnp.where(
+                    jnp.arange(self.d_pad) < cfg.grad_size, update, 0.0)
         ps_weights = state.ps_weights - update
 
         # ---- write back per-client rows
@@ -322,7 +382,9 @@ class FedRuntime:
             if cfg.mode == "true_topk" and sup_mask is not None:
                 # momentum factor masking on participating clients' local
                 # velocities (intended behavior of fed_aggregator.py:528-533)
-                new_rows = jnp.where(sup_mask[None, :], 0.0, new_rows)
+                # — the server mask is in padded space, client rows at true d
+                new_rows = jnp.where(sup_mask[None, : cfg.grad_size],
+                                     0.0, new_rows)
             client_velocities = client_velocities.at[client_ids].set(new_rows)
         client_errors = state.client_errors
         if out.error is not None and client_errors is not None:
@@ -333,6 +395,16 @@ class FedRuntime:
         if cfg.track_bytes:
             coord_last_update = jnp.where(
                 update != 0, state.step, state.coord_last_update)
+
+        # device-side divergence detection: record the FIRST round where a
+        # client loss, the aggregated gradient, or the weight update went
+        # non-finite (fused isfinite+reduce; a NaN gradient does not always
+        # survive the top-k select into the update, and the reference's
+        # host check is on the loss, cv_train.py:222-224)
+        bad = (~jnp.isfinite(update).all() | ~jnp.isfinite(agg).all()
+               | ~jnp.isfinite(out.results[0]).all())
+        nan_round = jnp.where((state.nan_round < 0) & bad, state.step,
+                              state.nan_round)
 
         new_state = FedState(
             ps_weights=ps_weights,
@@ -345,6 +417,7 @@ class FedRuntime:
             client_weights=client_weights,
             coord_last_update=coord_last_update,
             client_last_round=client_last_round,
+            nan_round=nan_round,
         )
         metrics = {
             "results": out.results,          # tuple of (num_workers,) arrays
@@ -355,7 +428,8 @@ class FedRuntime:
         return new_state, metrics
 
     def _val_step(self, ps_weights: jax.Array, batch: Any, mask: jax.Array):
-        return self._val_fn_inner(ps_weights, batch, mask)
+        return self._val_fn_inner(ps_weights[: self.cfg.grad_size], batch,
+                                  mask)
 
     # -------------------------------------------------------------- user API
 
@@ -364,16 +438,28 @@ class FedRuntime:
         """Run one federated round. ``client_ids``: (num_workers,) int32;
         ``batch``: pytree with leaves (num_workers, batch_size, ...);
         ``mask``: (num_workers, batch_size); ``lr``: scalar or (d,) vector."""
+        lr = jnp.asarray(lr, jnp.float32)
+        if lr.ndim == 1 and lr.shape[0] != self.d_pad:
+            # per-param LR vector (Fixup groups): pad to the server's
+            # mesh-padded length (padding coords get multiplier 1; their
+            # update is identically 0)
+            lr = jnp.pad(lr, (0, self.d_pad - lr.shape[0]),
+                         constant_values=1.0)
         return self._round(state, jnp.asarray(client_ids, jnp.int32), batch,
-                           jnp.asarray(mask), jnp.asarray(lr, jnp.float32),
-                           self.cs)
+                           jnp.asarray(mask), lr, self.cs)
 
     def val(self, state: FedState, batch, mask):
         """Masked evaluation on the current PS weights; returns
         (results_tuple, n_valid)."""
         return self._val(state.ps_weights, batch, jnp.asarray(mask))
 
+    def flat_weights(self, state: FedState) -> jax.Array:
+        """The true-d flat weight vector (mesh padding sliced off) — the
+        ONE accessor every consumer of ``state.ps_weights`` outside the
+        round step must use; a padded vector does not unravel."""
+        return state.ps_weights[: self.cfg.grad_size]
+
     def get_params(self, state: FedState):
         """Materialize the model parameter pytree from the flat PS weights
         (reference __getattr__ trick, fed_aggregator.py:372-376)."""
-        return self.unravel(state.ps_weights)
+        return self.unravel(self.flat_weights(state))

@@ -41,3 +41,10 @@ class FedState:
     # byte accounting (see module docstring)
     coord_last_update: Optional[jax.Array] = None  # (d,) int32, init -1
     client_last_round: Optional[jax.Array] = None  # (num_clients,) int32
+    # device-side divergence flag: the first round whose weight update went
+    # non-finite, or -1. The reference checks the loss on the host every
+    # round (cv_train.py:222-224); keeping the flag in device state
+    # preserves the fetch-once-per-epoch discipline while still reporting
+    # the exact offending round — and lets drivers refuse to checkpoint
+    # poisoned state.
+    nan_round: Optional[jax.Array] = None          # () int32, init -1

@@ -100,7 +100,8 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
     if cfg.do_resume:
         restored, meta = mgr.restore_latest(
             sharding=runtime._state_sharding, expect_fingerprint=fp,
-            allow_missing_fingerprint=cfg.resume_unverified)
+            allow_missing_fingerprint=cfg.resume_unverified,
+            d_pad=runtime.d_pad)
         if restored is not None:
             start = int(meta.get("epoch", 0))
             print(f"resumed from epoch {start}")
@@ -252,9 +253,14 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
         train_time = timer()
         # NaN abort, checked at the epoch boundary (the reference checks per
         # round, cv_train.py:222-224 — per-round host fetches are what this
-        # loop exists to avoid)
-        if np.isnan(sums[0]):
-            print(f"LOSS OF {sums[0]} IS NAN, TERMINATING TRAINING")
+        # loop exists to avoid). The device-side flag reports the exact
+        # offending round and gates every checkpoint write below, so
+        # poisoned state is never persisted.
+        nan_round = int(state.nan_round)
+        if nan_round >= 0 or np.isnan(sums[0]):
+            which = (f"first non-finite update at round {nan_round}"
+                     if nan_round >= 0 else f"epoch loss {sums[0]} is NaN")
+            print(f"TRAINING DIVERGED ({which}), TERMINATING")
             return state, None
         total = max(float(sums[2]), 1.0)
         train_loss = float(sums[0]) / total
@@ -357,7 +363,7 @@ def main(argv=None):
     if cfg.do_checkpoint and summary is not None:
         os.makedirs(cfg.checkpoint_path, exist_ok=True)
         path = os.path.join(cfg.checkpoint_path, cfg.model + ".npz")
-        np.savez(path, ps_weights=np.asarray(state.ps_weights))
+        np.savez(path, ps_weights=np.asarray(runtime.flat_weights(state)))
         print(f"saved checkpoint to {path}")
     return summary
 

@@ -114,7 +114,7 @@ def main(argv=None):
     if cfg.do_checkpoint and summary is not None:
         os.makedirs(cfg.checkpoint_path, exist_ok=True)
         path = os.path.join(cfg.checkpoint_path, "gpt2_doubleheads.npz")
-        np.savez(path, ps_weights=np.asarray(state.ps_weights))
+        np.savez(path, ps_weights=np.asarray(runtime.flat_weights(state)))
         print(f"saved checkpoint to {path}")
     return summary
 

@@ -108,3 +108,45 @@ def test_fingerprint_guard(tmp_path):
     # callers that pass no expectation are unaffected
     restored, _ = mgr2.restore_latest()
     assert restored is not None
+
+
+def test_legacy_checkpoint_migration(tmp_path):
+    """Checkpoints written before the nan_round field / mesh padding exist
+    must still resume: missing nan_round defaults to -1, and dense server
+    leaves re-pad to the restoring runtime's d_pad (cross-topology
+    resume)."""
+    rt = build_runtime()
+    state = rt.init_state()
+    path = str(tmp_path / "old")
+    save_state(path, state)
+    # forge an old-format checkpoint: strip nan_round from the npz
+    with np.load(path + ".npz") as z:
+        arrays = {k: z[k] for k in z.files if k != "nan_round"}
+    with open(path + ".npz", "wb") as f:
+        np.savez(f, **arrays)
+
+    loaded = load_state(path)
+    assert int(loaded.nan_round) == -1
+
+    # cross-topology: restore the single-device (d=19) state into a mesh
+    # runtime whose d_pad=24
+    from commefficient_tpu.parallel import make_mesh
+    mesh = make_mesh((8,), ("clients",))
+    cfg = make_cfg(mode="true_topk", error_type="virtual", k=5)
+    params = {"w": jnp.asarray(
+        np.random.RandomState(0).randn(6, 3), jnp.float32)}
+    rt_mesh = FedRuntime(cfg, params, quad_loss, num_clients=16, mesh=mesh)
+    migrated = load_state(path, sharding=rt_mesh._state_sharding,
+                          d_pad=rt_mesh.d_pad)
+    assert migrated.ps_weights.shape == (rt_mesh.d_pad,)
+    assert int(migrated.nan_round) == -1
+    np.testing.assert_array_equal(
+        np.asarray(migrated.coord_last_update[rt_mesh.cfg.grad_size:]), -1)
+    # and the migrated state actually runs a round
+    batch, mask, cids = make_batch(3)
+    s2, _ = rt_mesh.round(migrated, cids, batch, mask, 0.05)
+    assert np.isfinite(np.asarray(s2.ps_weights)).all()
+    # the reverse direction: mesh checkpoint restored at true d
+    save_state(str(tmp_path / "mesh"), s2)
+    back = load_state(str(tmp_path / "mesh"), d_pad=rt.cfg.grad_size)
+    assert back.ps_weights.shape == (rt.cfg.grad_size,)

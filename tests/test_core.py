@@ -276,3 +276,62 @@ class TestPerParamLR:
         upd_ref = w0 - np.asarray(s_ref.ps_weights)
         np.testing.assert_allclose(upd_vec, upd_ref * mult,
                                    rtol=1e-5, atol=1e-7)
+
+
+class TestNanFlag:
+    """Device-side divergence flag (VERDICT r1 next #8): nan_round records
+    the FIRST round whose loss/gradient/update went non-finite, without any
+    per-round host fetch."""
+
+    def test_records_first_bad_round(self):
+        cfg = base_cfg()
+        rt = FedRuntime(cfg, init_params(), loss_fn,
+                        num_clients=NUM_CLIENTS)
+        state = rt.init_state()
+        xs, ys = make_data()
+        ids = np.arange(W, dtype=np.int32)
+        good = {"x": jnp.asarray(xs[ids]), "y": jnp.asarray(ys[ids])}
+        bad = {"x": good["x"].at[0, 0, 0].set(jnp.nan), "y": good["y"]}
+        mask = jnp.ones((W, B))
+
+        state, _ = rt.round(state, ids, good, mask, 0.05)
+        assert int(state.nan_round) == -1
+        state, _ = rt.round(state, ids, bad, mask, 0.05)
+        assert int(state.nan_round) == 1
+        # weights are now poisoned; later rounds stay flagged at round 1
+        state, _ = rt.round(state, ids, good, mask, 0.05)
+        assert int(state.nan_round) == 1
+
+    def test_train_loop_aborts_without_checkpoint(self, tmp_path):
+        """The driver epoch loop reports the offending round and refuses to
+        write a checkpoint of poisoned state."""
+        from commefficient_tpu import models
+        from commefficient_tpu.checkpoint import CheckpointManager
+        from commefficient_tpu.cv_train import train
+        from commefficient_tpu.data import FedCIFAR10, transforms_for
+        from commefficient_tpu.losses import make_cv_loss
+
+        ds = FedCIFAR10(str(tmp_path / "d"), synthetic=True,
+                        synthetic_per_class=4,
+                        transform=transforms_for("CIFAR10", False))
+        cfg = FedConfig(mode="uncompressed", error_type="none",
+                        local_momentum=0.0, virtual_momentum=0.0,
+                        num_workers=2, local_batch_size=4,
+                        num_clients=ds.num_clients, num_epochs=1.0,
+                        track_bytes=False, compute_dtype="float32",
+                        checkpoint_every=1)
+        model = models.ResNet9(num_classes=10,
+                               channels={"prep": 2, "layer1": 2,
+                                         "layer2": 2, "layer3": 2})
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.ones((1, 32, 32, 3)))
+        # poison the initial weights: every round's update is non-finite
+        params = jax.tree.map(lambda t: t * jnp.nan, params)
+        rt = FedRuntime(cfg, params, make_cv_loss(model, "float32"),
+                        num_clients=ds.num_clients)
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        state, summary = train(cfg, rt, rt.init_state(), ds, ds,
+                               ckpt_mgr=mgr)
+        assert summary is None            # aborted
+        assert int(state.nan_round) == 0  # flagged on the very first round
+        assert mgr.epochs() == []         # nothing persisted
