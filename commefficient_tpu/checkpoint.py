@@ -67,16 +67,20 @@ def save_state(path: str, state: FedState,
     return path + ".npz"
 
 
-def load_state(path: str, sharding=None,
-               d_pad: Optional[int] = None) -> FedState:
+def load_state(path: str, sharding=None, d_pad: Optional[int] = None,
+               num_clients: Optional[int] = None) -> FedState:
     """Rebuild a FedState; optional sharding pytree (from
     ``FedRuntime._state_sharding``) places arrays sharded on load.
 
     Migrations for checkpoints written by earlier versions / other
-    topologies: a missing ``nan_round`` defaults to -1, and when ``d_pad``
+    topologies: a missing ``nan_round`` defaults to -1; when ``d_pad``
     (the restoring runtime's padded dense length) is given, 1-D dense
-    server leaves are zero-padded or sliced to it — so a single-device
-    checkpoint resumes on a mesh and vice versa."""
+    server leaves are zero-padded or sliced to it; when ``num_clients``
+    (the restoring runtime's mesh-padded client count) is given,
+    per-client row arrays are padded (new rows start as fresh clients:
+    zero velocity/error, current PS weights, never-participated) or
+    truncated — so a single-device checkpoint resumes on a mesh and vice
+    versa."""
     with np.load(path + ".npz") as z:
         kw = {name: (np.asarray(z[name]) if name in z.files else None)
               for name in _FIELDS}
@@ -94,6 +98,30 @@ def load_state(path: str, sharding=None,
                 else:
                     arr = arr[:d_pad]
                 kw[name] = arr
+    if num_clients is not None:
+        for name in ("client_velocities", "client_errors",
+                     "client_weights", "client_last_round"):
+            arr = kw.get(name)
+            if arr is None or arr.shape[0] == num_clients:
+                continue
+            if arr.shape[0] < num_clients:
+                extra = num_clients - arr.shape[0]
+                if name == "client_weights":
+                    # fresh clients hold the current PS weights
+                    # (init semantics, reference fed_aggregator.py:105-111)
+                    d = arr.shape[1]
+                    rows = np.broadcast_to(kw["ps_weights"][:d],
+                                           (extra, d))
+                    arr = np.concatenate([arr, rows])
+                else:
+                    pad = [(0, extra)] + [(0, 0)] * (arr.ndim - 1)
+                    arr = np.pad(arr, pad)
+            else:
+                # only mesh-padding rows (never-sampled clients) are
+                # droppable; a genuinely smaller client universe should
+                # not reuse this checkpoint
+                arr = arr[:num_clients]
+            kw[name] = arr
     state = FedState(**{k: (jax.numpy.asarray(v) if v is not None else None)
                         for k, v in kw.items()})
     if sharding is not None:
@@ -151,7 +179,8 @@ class CheckpointManager:
         return es[-1] if es else None
 
     def restore_latest(self, sharding=None, expect_fingerprint=None,
-                       allow_missing_fingerprint=False, d_pad=None):
+                       allow_missing_fingerprint=False, d_pad=None,
+                       num_clients=None):
         """Returns (state, meta) or (None, {}). When the caller carries a
         params fingerprint, a mismatch — or a checkpoint that predates
         fingerprinting and so carries none — raises instead of resuming into
@@ -180,5 +209,5 @@ class CheckpointManager:
                     f"{expect_fingerprint}); the flat ps_weights vector "
                     "would unravel into the wrong weights. Re-create the "
                     "run or load with the original model configuration.")
-        return load_state(self._path(e), sharding=sharding,
-                          d_pad=d_pad), meta
+        return load_state(self._path(e), sharding=sharding, d_pad=d_pad,
+                          num_clients=num_clients), meta

@@ -358,6 +358,11 @@ class FedRuntime:
 
         # ---- server update
         server_lr = jnp.asarray(1.0) if cfg.mode == "fedavg" else lr
+        if (cfg.mode == "sketch" and not self._dense_preimage
+                and server_lr.ndim == 1):
+            # the sketch branch multiplies lr against the TRUE-d decoded
+            # update (its state is the table, not a padded dense vector)
+            server_lr = server_lr[: cfg.grad_size]
         update, Vvel, Verr, sup_mask = server_update(
             cfg, agg, state.Vvelocity, state.Verror, server_lr,
             cs=cs, dp_rng=server_rng,

@@ -101,7 +101,7 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
         restored, meta = mgr.restore_latest(
             sharding=runtime._state_sharding, expect_fingerprint=fp,
             allow_missing_fingerprint=cfg.resume_unverified,
-            d_pad=runtime.d_pad)
+            d_pad=runtime.d_pad, num_clients=runtime.num_clients)
         if restored is not None:
             start = int(meta.get("epoch", 0))
             print(f"resumed from epoch {start}")
@@ -164,27 +164,31 @@ def make_writer(cfg: FedConfig):
 
 def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
           lr_mult: Optional[jax.Array] = None, loggers=(), timer=None,
-          ckpt_mgr=None, start_epoch: int = 0, writer=None):
+          ckpt_mgr=None, start_epoch: int = 0, writer=None, schedule=None):
     timer = timer or Timer()
     # device-resident data path: upload the dataset once, gather + augment
     # each round's batch on device, accumulate metrics on device, and fetch
     # once per epoch — a host<->device transfer costs ~170 ms latency on
     # this runtime, so the reference's per-round stream-and-read pattern
-    # (cv_train.py:193-229) would dominate the ~50 ms round ~10x.
-    # Single-device only (the mesh path shards batches at ingest).
-    train_store = val_store = None
-    if runtime.mesh is None:
-        train_store = make_device_store(train_ds, cfg.dataset_name, True)
-        val_store = make_device_store(val_ds, cfg.dataset_name, False)
-        if train_store is not None:
-            print(f"device-resident data: train "
-                  f"{train_store.nbytes / 2**20:.0f} MiB"
-                  + (f", val {val_store.nbytes / 2**20:.0f} MiB"
-                     if val_store else ""))
+    # (cv_train.py:193-229) would dominate the ~50 ms round ~10x. On a
+    # mesh the arrays replicate across devices and train batches come out
+    # already sharded over the round's client axis.
+    train_store = make_device_store(train_ds, cfg.dataset_name, True,
+                                    mesh=runtime.mesh)
+    val_store = make_device_store(val_ds, cfg.dataset_name, False,
+                                  mesh=runtime.mesh)
+    if train_store is not None:
+        print(f"device-resident data: train "
+              f"{train_store.nbytes / 2**20:.0f} MiB"
+              + (f", val {val_store.nbytes / 2**20:.0f} MiB"
+                 if val_store else ""))
     data_key = jax.random.PRNGKey(cfg.seed ^ 0xDA7A)
-    schedule = PiecewiseLinear(
-        [0.0, cfg.pivot_epoch, float(cfg.num_epochs)],
-        [0.0, cfg.lr_scale if cfg.lr_scale is not None else 0.4, 0.0])
+    if schedule is None:
+        # CV default: the cifar10_fast triangular ramp
+        # (reference cv_train.py:393-404)
+        schedule = PiecewiseLinear(
+            [0.0, cfg.pivot_epoch, float(cfg.num_epochs)],
+            [0.0, cfg.lr_scale if cfg.lr_scale is not None else 0.4, 0.0])
 
     # one sampler per epoch, seeded by (seed, epoch): an interrupted run
     # resumed at epoch E replays exactly the round sequence the

@@ -12,6 +12,8 @@ Run:  python -m commefficient_tpu.gpt2_train --mode sketch \
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import math
 import os
 
@@ -47,6 +49,75 @@ def build_gpt2(cfg: FedConfig, tokenizer):
                           compute_dtype=jnp.dtype(cfg.compute_dtype),
                           remat=cfg.do_remat)
     return GPT2DoubleHeads(gcfg), gcfg
+
+
+def make_gpt2_schedule(cfg: FedConfig):
+    """Reference GPT-2 LR trajectory: LINEAR lr -> 0 from step 0
+    (gpt2_train.py:302-307) — not the CV triangular ramp."""
+    from commefficient_tpu.utils import PiecewiseLinear
+    lr0 = cfg.lr_scale if cfg.lr_scale is not None else 0.16
+    return PiecewiseLinear([0.0, float(cfg.num_epochs)], [lr0, 0.0])
+
+
+def save_pretrained(out_dir: str, runtime, state, gcfg: GPT2Config,
+                    tokenizer) -> None:
+    """Reference parity for ``model.save_pretrained(log_dir)`` +
+    ``tokenizer.save_pretrained`` + config (fed_aggregator.py:208-211,
+    gpt2_train.py:146, 280-283): the saved directory is reloadable as a
+    pretrained checkpoint WITHOUT the writing run's code/config in hand —
+    weights + model config + tokenizer artifacts together."""
+    os.makedirs(out_dir, exist_ok=True)
+    from commefficient_tpu.checkpoint import params_fingerprint
+    params = runtime.get_params(state)
+    np.savez(os.path.join(out_dir, "weights.npz"),
+             ps_weights=np.asarray(runtime.flat_weights(state)))
+    cfg_dict = dataclasses.asdict(gcfg)
+    cfg_dict["compute_dtype"] = str(jnp.dtype(gcfg.compute_dtype))
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump({"model_type": "gpt2_doubleheads", **cfg_dict,
+                   "params_fingerprint": params_fingerprint(params)}, f,
+                  indent=1)
+    if hasattr(tokenizer, "save_pretrained"):      # real GPT-2 BPE
+        tokenizer.save_pretrained(out_dir)
+    else:                                          # offline HashTokenizer
+        with open(os.path.join(out_dir, "hash_tokenizer.json"), "w") as f:
+            json.dump({"type": "HashTokenizer",
+                       "base_vocab": tokenizer.base_vocab}, f)
+    print(f"saved pretrained checkpoint to {out_dir}")
+
+
+def load_pretrained(out_dir: str):
+    """Rebuild (model, params, gcfg, tokenizer) from a ``save_pretrained``
+    directory. Refuses weight vectors whose layout does not match the
+    rebuilt model (fingerprint check)."""
+    from commefficient_tpu.checkpoint import params_fingerprint
+    from commefficient_tpu.data.fed_persona import HashTokenizer
+    with open(os.path.join(out_dir, "config.json")) as f:
+        cfg_dict = json.load(f)
+    saved_fp = cfg_dict.pop("params_fingerprint", None)
+    cfg_dict.pop("model_type", None)
+    cfg_dict["compute_dtype"] = jnp.dtype(cfg_dict["compute_dtype"])
+    gcfg = GPT2Config(**cfg_dict)
+    model = GPT2DoubleHeads(gcfg)
+    ids = jnp.zeros((1, 2, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids,
+                        jnp.zeros((1, 2), jnp.int32), ids)
+    fp = params_fingerprint(params)
+    if saved_fp is not None and fp != saved_fp:
+        raise ValueError(
+            f"{out_dir}: saved weights were written under a different "
+            f"parameter layout ({saved_fp} != {fp})")
+    from commefficient_tpu.ops import ravel_params
+    _, unravel = ravel_params(params)
+    flat = np.load(os.path.join(out_dir, "weights.npz"))["ps_weights"]
+    params = unravel(jnp.asarray(flat))
+    hash_fn = os.path.join(out_dir, "hash_tokenizer.json")
+    if os.path.exists(hash_fn):
+        with open(hash_fn) as f:
+            tokenizer = HashTokenizer(json.load(f)["base_vocab"])
+    else:
+        tokenizer = get_tokenizer(out_dir)
+    return model, params, gcfg, tokenizer
 
 
 def main(argv=None):
@@ -102,20 +173,24 @@ def main(argv=None):
     if restored is not None:
         state = restored
 
+    from commefficient_tpu.cv_train import make_writer
     state, summary = shared_train(cfg, runtime, state, train_ds, val_ds,
                                   loggers=(TableLogger(),), timer=timer,
                                   ckpt_mgr=ckpt_mgr,
-                                  start_epoch=start_epoch)
+                                  start_epoch=start_epoch,
+                                  schedule=make_gpt2_schedule(cfg),
+                                  writer=make_writer(cfg))
 
     if summary is not None:
         nll = summary["test_loss"]
         print(f"final val nll {nll:.4f} ppl {math.exp(min(nll, 20)):.2f} "
               f"mc acc {summary['test_acc']:.4f}")
     if cfg.do_checkpoint and summary is not None:
-        os.makedirs(cfg.checkpoint_path, exist_ok=True)
-        path = os.path.join(cfg.checkpoint_path, "gpt2_doubleheads.npz")
-        np.savez(path, ps_weights=np.asarray(runtime.flat_weights(state)))
-        print(f"saved checkpoint to {path}")
+        # reference parity: weights + config + tokenizer, reloadable
+        # without this run's code in hand (fed_aggregator.py:208-211)
+        save_pretrained(os.path.join(cfg.checkpoint_path,
+                                     "gpt2_doubleheads"),
+                        runtime, state, gcfg, tokenizer)
     return summary
 
 

@@ -117,6 +117,22 @@ class CirculantSketch:
 
     # ---------------------------------------------------------------- ops
 
+    # above this many blocks the unrolled static rolls stop paying off:
+    # tracing/compile time scales with m, so switch to one (m, c) gather
+    # per row (same semantics; only arises at extreme d/c ratios — the
+    # flagship configs have m <= ~250)
+    _UNROLL_MAX_BLOCKS = 512
+
+    def _row_shift_idx(self, j: int, sign: int, b0: int = 0,
+                       nb: Optional[int] = None) -> jax.Array:
+        """(nb, c) column indices implementing per-block rolls by
+        ``sign * shifts[j]`` for blocks [b0, b0+nb) as one
+        take_along_axis."""
+        nb = self.m - b0 if nb is None else nb
+        s = jnp.asarray(self.shifts[j][b0:b0 + nb], jnp.int32)[:, None]
+        k = jnp.arange(self.c, dtype=jnp.int32)[None, :]
+        return (k - sign * s) % self.c
+
     def encode(self, vec: jax.Array) -> jax.Array:
         assert vec.ndim == 1 and vec.shape[0] == self.d, (vec.shape, self.d)
         m, c = self.m, self.c
@@ -125,9 +141,13 @@ class CirculantSketch:
         rows = []
         for j in range(self.r):
             sv = self._signs(j) * vp                       # (m, c)
-            # static per-block rolls: each compiles to slice+slice+concat
-            rolled = jnp.stack(
-                [jnp.roll(sv[b], self.shifts[j][b]) for b in range(m)])
+            if m <= self._UNROLL_MAX_BLOCKS:
+                # static per-block rolls: slice+slice+concat each
+                rolled = jnp.stack(
+                    [jnp.roll(sv[b], self.shifts[j][b]) for b in range(m)])
+            else:
+                rolled = jnp.take_along_axis(
+                    sv, self._row_shift_idx(j, sign=1), axis=1)
             rows.append(rolled.sum(axis=0))
         return jnp.stack(rows)
 
@@ -142,15 +162,24 @@ class CirculantSketch:
         assert table.shape == self.table_shape, (table.shape,
                                                  self.table_shape)
         m, c = self.m, self.c
-        # chunk the m axis so peak memory is O(r * m/num_blocks * c)
+        # chunk the m axis so peak memory is O(r * m/num_blocks * c) on
+        # both implementations of the per-block shift
         chunk = max(1, -(-m // max(1, self.num_blocks)))
         outs = []
         for b0 in range(0, m, chunk):
             mb = min(chunk, m - b0)
-            ests = jnp.stack([
-                jnp.stack([jnp.roll(table[j], -self.shifts[j][b])
-                           for b in range(b0, b0 + mb)])
-                for j in range(self.r)])                  # (r, mb, c)
+            if m > self._UNROLL_MAX_BLOCKS:
+                ests = jnp.stack([
+                    jnp.take_along_axis(
+                        jnp.broadcast_to(table[j], (mb, c)),
+                        self._row_shift_idx(j, sign=-1, b0=b0, nb=mb),
+                        axis=1)
+                    for j in range(self.r)])              # (r, mb, c)
+            else:
+                ests = jnp.stack([
+                    jnp.stack([jnp.roll(table[j], -self.shifts[j][b])
+                               for b in range(b0, b0 + mb)])
+                    for j in range(self.r)])              # (r, mb, c)
             signs = jnp.stack(
                 [self._signs(j, b0, mb) for j in range(self.r)])
             outs.append(median_axis0(ests * signs).reshape(-1))

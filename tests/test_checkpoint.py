@@ -150,3 +150,37 @@ def test_legacy_checkpoint_migration(tmp_path):
     save_state(str(tmp_path / "mesh"), s2)
     back = load_state(str(tmp_path / "mesh"), d_pad=rt.cfg.grad_size)
     assert back.ps_weights.shape == (rt.cfg.grad_size,)
+
+
+def test_client_row_migration(tmp_path):
+    """Per-client rows pad/truncate to the restoring runtime's (possibly
+    mesh-padded) client count: a single-device checkpoint with
+    num_clients=18 resumes on an 8-device mesh that pads to 24."""
+    from commefficient_tpu.parallel import make_mesh
+
+    cfg = make_cfg(mode="local_topk", error_type="local", k=4,
+                   local_momentum=0.9, do_topk_down=True)
+    params = {"w": jnp.asarray(
+        np.random.RandomState(0).randn(6, 3), jnp.float32)}
+    rt18 = FedRuntime(cfg, params, quad_loss, num_clients=18)
+    s = rt18.init_state()
+    batch, mask, cids = make_batch(3)
+    s, _ = rt18.round(s, cids, batch, mask, 0.05)
+    save_state(str(tmp_path / "c18"), s)
+
+    mesh = make_mesh((8,), ("clients",))
+    rt_mesh = FedRuntime(cfg, params, quad_loss, num_clients=18, mesh=mesh)
+    assert rt_mesh.num_clients == 24
+    mig = load_state(str(tmp_path / "c18"),
+                     sharding=rt_mesh._state_sharding,
+                     d_pad=rt_mesh.d_pad, num_clients=24)
+    assert mig.client_errors.shape[0] == 24
+    # old rows preserved, new rows are fresh clients
+    np.testing.assert_array_equal(np.asarray(mig.client_errors[:18]),
+                                  np.asarray(s.client_errors))
+    np.testing.assert_array_equal(np.asarray(mig.client_errors[18:]), 0.0)
+    np.testing.assert_array_equal(
+        np.asarray(mig.client_weights[18:]),
+        np.broadcast_to(np.asarray(s.ps_weights[:18]), (6, 18)))
+    s2, _ = rt_mesh.round(mig, cids, batch, mask, 0.05)
+    assert np.isfinite(np.asarray(s2.ps_weights)).all()

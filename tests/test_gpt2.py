@@ -197,3 +197,57 @@ def test_persona_history_and_permutations(tmp_path):
                   short.tokenizer.convert_tokens_to_ids("<pad>")).sum()
     assert lens_short <= lens_base
     assert len(short) == len(base)
+
+
+def test_gpt2_lr_schedule_is_linear_to_zero():
+    """Reference gpt2_train.py:302-307: LR decays LINEARLY from lr_scale at
+    epoch 0 to 0 at num_epochs — distinct from the CV triangular ramp."""
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.gpt2_train import make_gpt2_schedule
+
+    cfg = FedConfig(lr_scale=0.16, num_epochs=4.0, local_momentum=0.0)
+    s = make_gpt2_schedule(cfg)
+    assert s(0.0) == 0.16                 # full LR at step 0 (no warmup)
+    assert abs(s(1.0) - 0.12) < 1e-9      # linear
+    assert abs(s(2.0) - 0.08) < 1e-9
+    assert s(4.0) == 0.0
+
+
+def test_save_pretrained_roundtrip(tmp_path):
+    """save_pretrained emits weights + config + tokenizer together and
+    load_pretrained rebuilds an equivalent model with no access to the
+    writing run (reference fed_aggregator.py:208-211 parity)."""
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.core import FedRuntime
+    from commefficient_tpu.data.fed_persona import HashTokenizer
+    from commefficient_tpu.gpt2_train import load_pretrained, save_pretrained
+    from commefficient_tpu.losses import make_gpt2_train_loss
+
+    tok = HashTokenizer(128)
+    gcfg = GPT2Config.small(vocab_size=len(tok) - 5,
+                            compute_dtype=jnp.float32)
+    model = GPT2DoubleHeads(gcfg)
+    ids = jnp.zeros((1, 2, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(1), ids,
+                        jnp.zeros((1, 2), jnp.int32), ids)
+    cfg = FedConfig(mode="uncompressed", error_type="none",
+                    local_momentum=0.0, num_workers=2, local_batch_size=2,
+                    num_clients=4, track_bytes=False, num_results_train=3)
+    rt = FedRuntime(cfg, params, make_gpt2_train_loss(model),
+                    num_clients=4)
+    state = rt.init_state()
+    out = str(tmp_path / "pretrained")
+    save_pretrained(out, rt, state, gcfg, tok)
+    import os
+    assert os.path.exists(os.path.join(out, "weights.npz"))
+    assert os.path.exists(os.path.join(out, "config.json"))
+    assert os.path.exists(os.path.join(out, "hash_tokenizer.json"))
+
+    model2, params2, gcfg2, tok2 = load_pretrained(out)
+    assert gcfg2 == gcfg
+    assert isinstance(tok2, HashTokenizer) and len(tok2) == len(tok)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), params, params2)
+    # the reloaded model runs
+    lm, mc = model2.apply(params2, ids, jnp.zeros((1, 2), jnp.int32), ids)
+    assert lm.shape == (1, 2, 8, gcfg.total_vocab)

@@ -258,3 +258,21 @@ class TestCirculantSketch:
         t = jax.jit(lambda cs, x: cs.encode(x))(ccs, v)
         np.testing.assert_allclose(np.asarray(t), np.asarray(ccs.encode(v)),
                                    atol=1e-4)
+
+    def test_gather_fallback_matches_unrolled(self, monkeypatch):
+        """Extreme d/c ratios (m > _UNROLL_MAX_BLOCKS) switch encode/decode
+        to one (m, c) gather per row; results must be identical to the
+        static-roll path."""
+        from commefficient_tpu.ops import circulant as circ
+        cs = circ.make_circulant_sketch(d=119, c=2, r=3, seed=3)  # m=60
+        rng = np.random.RandomState(1)
+        v = jnp.asarray(rng.randn(119).astype(np.float32))
+        t_roll = cs.encode(v)
+        dec_roll = cs.decode(t_roll)
+        monkeypatch.setattr(circ.CirculantSketch, "_UNROLL_MAX_BLOCKS", 8)
+        t_gather = cs.encode(v)
+        np.testing.assert_allclose(np.asarray(t_roll),
+                                   np.asarray(t_gather), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(dec_roll),
+                                   np.asarray(cs.decode(t_gather)),
+                                   atol=1e-5)

@@ -202,3 +202,22 @@ def test_fedavg_vector_lr_on_mesh():
     s_ref, _ = rt.round(rt.init_state(), cids, batch, mask, 0.05)
     np.testing.assert_allclose(np.asarray(s2.ps_weights),
                                np.asarray(s_ref.ps_weights), rtol=1e-5)
+
+
+def test_sketch_vector_lr_on_mesh():
+    """Per-param LR vector in sketch mode on a non-divisible-d mesh: the
+    padded vector must slice back to true d for the table-space server
+    update."""
+    cfg = make_cfg(mode="sketch", error_type="virtual", k=5, num_rows=3,
+                   num_cols=32, num_blocks=2)
+    params = {"w": jnp.asarray(
+        np.random.RandomState(0).randn(6, 3), jnp.float32)}
+    mesh = make_mesh((8,), ("clients",))
+    rt = FedRuntime(cfg, params, quad_loss, num_clients=16, mesh=mesh)
+    assert rt.d_pad != rt.cfg.grad_size
+    batch, mask, cids = make_batch(1)
+    lr_vec = jnp.full((rt.cfg.grad_size,), 0.05, jnp.float32)
+    s2, _ = rt.round(rt.init_state(), cids, batch, mask, lr_vec)
+    s_ref, _ = rt.round(rt.init_state(), cids, batch, mask, 0.05)
+    np.testing.assert_allclose(np.asarray(s2.ps_weights),
+                               np.asarray(s_ref.ps_weights), rtol=1e-5)
