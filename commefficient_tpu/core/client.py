@@ -110,11 +110,16 @@ def make_forward_grad(
         results = (loss_sum / denom,) + tuple(
             m / denom for m in metrics_sum)
 
-        # decoupled weight decay (reference utils.py:254-259)
+        # decoupled weight decay (reference utils.py:254-259). Seq-sharded
+        # rounds sum per-shard terms then divide by the shard count in the
+        # runtime's aggregation, so no per-shard correction is needed here.
         if cfg.weight_decay != 0:
             g = g + (cfg.weight_decay / cfg.num_workers) * params_vec
         # grad-norm clipping for dense modes (reference fed_worker.py:290-292;
-        # threshold scales with the number of accumulation steps)
+        # threshold scales with the number of accumulation steps). Not
+        # available seq-sharded (the runtime forbids it): the clip needs the
+        # norm of the SUMMED client gradient, which per-shard norms cannot
+        # provide (partials are not orthogonal).
         if cfg.max_grad_norm is not None and cfg.mode != "sketch":
             g = clip_by_l2_norm(g, cfg.max_grad_norm * num_iters)
         # differential privacy (reference fed_worker.py:304-309)
@@ -155,6 +160,10 @@ def make_client_step(
     -> ClientOut``.
     ``velocity``/``error`` are this client's persistent rows (or None when the
     mode doesn't allocate them, reference fed_aggregator.py:105-129).
+
+    Seq-sharded rounds (runtime seq axis): the loss closure itself carries
+    the seq semantics (losses.make_gpt2_train_loss seq_axis); this step is
+    per-shard linear and the runtime handles the cross-shard sum/scale.
     """
     fwd = make_forward_grad(cfg, loss_fn, unravel, batch_size,
                             defer_encode=defer_encode)

@@ -28,7 +28,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax, shard_map
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from commefficient_tpu.config import FedConfig
 from commefficient_tpu.core import client as client_lib
@@ -58,7 +58,8 @@ class FedRuntime:
                  loss_fn_train: Callable,
                  loss_fn_val: Optional[Callable] = None,
                  num_clients: Optional[int] = None,
-                 mesh=None):
+                 mesh=None,
+                 seq_spec: Optional[Dict[str, int]] = None):
         flat, unravel = ravel_params(params)
         cfg = cfg.replace(grad_size=int(flat.size))
         validate_mode_combo(cfg)
@@ -66,6 +67,36 @@ class FedRuntime:
         self.unravel = unravel
         self.initial_weights = flat
         self.mesh = mesh
+        # sequence/context parallelism: a mesh with a "seq" axis runs every
+        # client's model seq-sharded (ring attention; see parallel/ring.py
+        # and the seq_axis machinery in models/gpt2.py + losses.py).
+        # ``seq_spec`` maps batch leaf names -> the index of their sequence
+        # dimension (leaves absent from it replicate over the seq axis).
+        self._seq_axis = ("seq" if (mesh is not None
+                                    and "seq" in mesh.axis_names) else None)
+        self._seq_shards = (mesh.shape["seq"] if self._seq_axis else 1)
+        self._seq_spec = seq_spec or {}
+        if self._seq_axis:
+            # the per-shard client pipeline must be LINEAR in the gradient
+            # (shards sum): modes with per-client nonlinearities are out
+            if cfg.mode not in ("uncompressed", "true_topk", "sketch"):
+                raise ValueError(
+                    f"mode={cfg.mode} is incompatible with a seq mesh axis "
+                    "(per-client nonlinear pipeline; use uncompressed/"
+                    "true_topk/sketch)")
+            if (cfg.do_topk_down or cfg.do_dp
+                    or cfg.needs_client_velocities
+                    or cfg.needs_client_errors):
+                raise ValueError(
+                    "topk_down / DP / local client state are not supported "
+                    "with a seq mesh axis")
+            if cfg.max_grad_norm is not None:
+                raise ValueError(
+                    "max_grad_norm is unsupported with a seq mesh axis: "
+                    "clipping needs the norm of the client's SUMMED "
+                    "gradient, which per-shard partial norms cannot "
+                    "provide (and the sketch table clip is per-client "
+                    "nonlinear)")
         self.num_clients = (num_clients if num_clients is not None
                             else cfg.default_num_clients())
         if mesh is not None:
@@ -74,6 +105,7 @@ class FedRuntime:
             self.shardings = FedShardings(mesh)
             n_dev = mesh.shape[self.shardings.axis]
             self.num_clients = -(-self.num_clients // n_dev) * n_dev
+            n_dense = mesh.size  # dense vectors shard over ALL mesh axes
             # pad the dense federated vector too, so the SERVER state
             # (ps_weights, dense Vvelocity/Verror, coord_last_update) always
             # shards evenly over the mesh: the dense-mode client sum arrives
@@ -85,7 +117,7 @@ class FedRuntime:
             # fell back to a fully-replicated (d,) all-reduce — at GPT-2
             # scale a 500 MB collective where a shard-sized one suffices
             # (ref aggregation: fed_aggregator.py:326-332, 446-458).
-            self.d_pad = -(-cfg.grad_size // n_dev) * n_dev
+            self.d_pad = -(-cfg.grad_size // n_dense) * n_dense
         else:
             self.shardings = None
             self.d_pad = cfg.grad_size
@@ -138,13 +170,13 @@ class FedRuntime:
         if self.shardings is not None:
             sh = self.shardings
             state_sh = sh.for_state(cfg, self._state_template())
-            batch_leaf = sh.round_axis
+            batch_sh = self.batch_sharding()
             cs_sh = jax.tree.map(lambda _: sh.replicated, self.cs)
             self._round = jax.jit(
                 self._round_step,
                 donate_argnums=(0,),
-                in_shardings=(state_sh, batch_leaf, batch_leaf, batch_leaf,
-                              None, cs_sh),
+                in_shardings=(state_sh, sh.round_axis, batch_sh,
+                              sh.round_axis, None, cs_sh),
                 out_shardings=(state_sh, None),
             )
             self._state_sharding = state_sh
@@ -152,6 +184,25 @@ class FedRuntime:
             self._round = jax.jit(self._round_step, donate_argnums=(0,))
             self._state_sharding = None
         self._val = jax.jit(self._val_step)
+
+    def _batch_pspec(self, seq_dim: Optional[int]) -> P:
+        """PartitionSpec for one batch leaf: clients on dim 0, and (when
+        seq-sharded) the seq axis at ``seq_dim``."""
+        ax = self.shardings.axis
+        if self._seq_axis is None or seq_dim is None:
+            return P(ax)
+        return P(*([ax] + [None] * (seq_dim - 1) + [self._seq_axis]))
+
+    def batch_sharding(self):
+        """Per-leaf NamedShardings for the batch jit argument — the layout
+        any batch producer (e.g. a DeviceStore) must emit on a mesh.
+        Without a seq axis every leaf shards on its leading (client) dim;
+        with one, ``seq_spec`` must name every batch leaf (value = its
+        sequence dim index, or None to replicate over seq)."""
+        if self._seq_axis is None or not self._seq_spec:
+            return self.shardings.round_axis
+        return {k: NamedSharding(self.mesh, self._batch_pspec(sd))
+                for k, sd in self._seq_spec.items()}
 
     # ------------------------------------------------------------------ state
 
@@ -301,6 +352,11 @@ class FedRuntime:
                 agg = cs.encode(agg)
             n_total = out.n_valid.sum()
             if self._axis is not None:
+                # the aggregation spans every mesh axis: clients sum across
+                # the clients axis, and (in seq mode) each client's partial
+                # per-shard gradients sum across the seq axis — one fused
+                # collective either way
+                all_axes = tuple(self.mesh.axis_names)
                 if agg.ndim == 1:
                     # dense modes: reduce_scatter the client sum so each
                     # device receives only its d_pad/n shard of the summed
@@ -309,11 +365,23 @@ class FedRuntime:
                     # payloads; reference reduce: fed_aggregator.py:326-332)
                     agg = lax.psum_scatter(
                         jnp.pad(agg, (0, self.d_pad - cfg.grad_size)),
-                        self._axis, scatter_dimension=0, tiled=True)
+                        all_axes, scatter_dimension=0, tiled=True)
                 else:
                     # sketch tables are already the compressed payload: one
                     # table-sized psum (analogue of encode-before-NCCL)
-                    agg = lax.psum(agg, self._axis)
+                    agg = lax.psum(agg, all_axes)
+                if self._seq_axis is not None:
+                    # shard_map autodiff with vma checking off transposes
+                    # psum to psum, so each seq shard's gradient comes out
+                    # scaled by seq_shards (every differentiable path in
+                    # the seq-sharded loss crosses exactly ONE psum — the
+                    # LM token mean or the MC logit reduction; verified
+                    # uniform by tests/test_seqparallel.py's round
+                    # equivalence). The cross-shard sum above therefore
+                    # over-counts by that factor once: divide it back.
+                    agg = agg / self._seq_shards
+                # datum counts are identical on every seq shard (the mask
+                # replicates over seq) — sum over clients only
                 n_total = lax.psum(n_total, self._axis)
             return agg, n_total, out.velocity, out.error, out.results, \
                 out.n_valid
@@ -321,9 +389,14 @@ class FedRuntime:
         if self._axis is not None:
             ax = self._axis
             row = P(ax)
+            if self._seq_axis and self._seq_spec:
+                batch_specs = {k: self._batch_pspec(sd)
+                               for k, sd in self._seq_spec.items()}
+            else:
+                batch_specs = jax.tree.map(lambda _: row, batch)
             in_specs = (
                 row if params_axis == 0 else P(),
-                jax.tree.map(lambda _: row, batch),
+                batch_specs,
                 row,
                 row if has_vel else None,
                 row if has_err else None,
@@ -331,11 +404,12 @@ class FedRuntime:
                 P(),
                 jax.tree.map(lambda _: P(), cs),
             )
+            # dense modes leave the block as a reduce_scattered shard of
+            # the summed gradient (over ALL axes); sketch leaves as a
+            # replicated (psum'd) table
+            dense_agg_spec = P(tuple(self.mesh.axis_names))
             out_specs = (
-                # dense modes leave the block as a reduce_scattered shard
-                # of the summed gradient; sketch leaves as a replicated
-                # (psum'd) table
-                row if cfg.mode != "sketch" else P(),
+                dense_agg_spec if cfg.mode != "sketch" else P(),
                 P(),
                 row if (cfg.mode != "fedavg" and has_vel) else None,
                 row if (cfg.mode != "fedavg" and has_err) else None,

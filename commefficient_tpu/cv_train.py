@@ -173,8 +173,10 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
     # (cv_train.py:193-229) would dominate the ~50 ms round ~10x. On a
     # mesh the arrays replicate across devices and train batches come out
     # already sharded over the round's client axis.
-    train_store = make_device_store(train_ds, cfg.dataset_name, True,
-                                    mesh=runtime.mesh)
+    train_store = make_device_store(
+        train_ds, cfg.dataset_name, True, mesh=runtime.mesh,
+        out_shardings=(runtime.batch_sharding()
+                       if runtime.mesh is not None else None))
     val_store = make_device_store(val_ds, cfg.dataset_name, False,
                                   mesh=runtime.mesh)
     if train_store is not None:

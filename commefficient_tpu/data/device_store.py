@@ -54,7 +54,8 @@ class DeviceStore:
                  iid_shuffle: Optional[np.ndarray] = None,
                  augment: Optional[str] = None,
                  mean=None, std=None, pad: int = 4,
-                 mesh=None, shard_axis: Optional[str] = None):
+                 mesh=None, shard_axis: Optional[str] = None,
+                 out_shardings=None):
         if mesh is not None:
             # mesh mode: the resident arrays REPLICATE across the mesh (a
             # CIFAR train set is ~150 MB — cheap next to model state) and
@@ -84,7 +85,12 @@ class DeviceStore:
                      if mean is not None else None)
         self.std = jnp.asarray(std, jnp.float32) if std is not None else None
         self.pad = pad
-        if self._out_sharding is not None:
+        if out_shardings is not None:
+            # explicit per-leaf layout (e.g. the runtime's seq-sharded
+            # batch shardings) — must match what the round jit expects
+            self._batch = jax.jit(self._batch_impl,
+                                  out_shardings=out_shardings)
+        elif self._out_sharding is not None:
             out_sh = jax.tree.map(lambda _: self._out_sharding, arrays)
             self._batch = jax.jit(self._batch_impl, out_shardings=out_sh)
         else:
@@ -158,7 +164,7 @@ _AUGMENT_FOR = {
 
 def make_device_store(dataset, dataset_name: str, train: bool,
                       max_bytes: int = 2 << 30,
-                      mesh=None) -> Optional[DeviceStore]:
+                      mesh=None, out_shardings=None) -> Optional[DeviceStore]:
     """Build a DeviceStore for a FedDataset when its arrays fit on device
     and the dataset's transform has a device equivalent; None => use the
     host pipeline. With a ``mesh``, arrays replicate across it and train
@@ -182,4 +188,5 @@ def make_device_store(dataset, dataset_name: str, train: bool,
         augment=(aug if train else ("normalize" if aug else None)),
         mean=mean, std=std, mesh=mesh,
         shard_axis=(mesh.axis_names[0] if mesh is not None and train
-                    else None))
+                    else None),
+        out_shardings=(out_shardings if train else None))

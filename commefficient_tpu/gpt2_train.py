@@ -40,6 +40,13 @@ from commefficient_tpu.models.gpt2 import (
 from commefficient_tpu.utils import TableLogger, Timer
 
 
+# batch leaf -> index of its sequence dimension in the per-round arrays
+# (leaves mapped to None replicate over the seq axis); leaf shapes are
+# (W, B, num_candidates, S) for token arrays
+PERSONA_SEQ_SPEC = {"input_ids": 3, "token_type_ids": 3, "lm_labels": 3,
+                    "mc_token_ids": None, "mc_label": None}
+
+
 def build_gpt2(cfg: FedConfig, tokenizer):
     n_vocab = len(tokenizer)
     if cfg.do_test:
@@ -68,15 +75,18 @@ def save_pretrained(out_dir: str, runtime, state, gcfg: GPT2Config,
     weights + model config + tokenizer artifacts together."""
     os.makedirs(out_dir, exist_ok=True)
     from commefficient_tpu.checkpoint import params_fingerprint
-    params = runtime.get_params(state)
+    # fingerprint needs only treedef + leaf shapes: eval_shape avoids
+    # materializing the full pytree (hundreds of MB at real GPT-2 scale)
+    params_shape = jax.eval_shape(runtime.unravel,
+                                  runtime.flat_weights(state))
     np.savez(os.path.join(out_dir, "weights.npz"),
              ps_weights=np.asarray(runtime.flat_weights(state)))
     cfg_dict = dataclasses.asdict(gcfg)
     cfg_dict["compute_dtype"] = str(jnp.dtype(gcfg.compute_dtype))
     with open(os.path.join(out_dir, "config.json"), "w") as f:
         json.dump({"model_type": "gpt2_doubleheads", **cfg_dict,
-                   "params_fingerprint": params_fingerprint(params)}, f,
-                  indent=1)
+                   "params_fingerprint": params_fingerprint(params_shape)},
+                  f, indent=1)
     if hasattr(tokenizer, "save_pretrained"):      # real GPT-2 BPE
         tokenizer.save_pretrained(out_dir)
     else:                                          # offline HashTokenizer
@@ -159,11 +169,35 @@ def main(argv=None):
     else:
         print("WARNING: no local pretrained GPT-2; training from scratch")
 
-    loss_train = make_gpt2_train_loss(model, cfg.lm_coef, cfg.mc_coef)
+    # long-context configuration: --mesh_axes clients,seq runs every
+    # client's model with the sequence sharded over the "seq" axis (ring
+    # attention, parallel/ring.py) — per-device attention memory drops from
+    # O(S^2) to O(S^2/n_seq) and activations to O(S/n_seq). New scope
+    # beyond the reference (SURVEY.md §5: no sequence parallelism).
+    mesh = build_mesh(cfg)
+    seq_shards = (mesh.shape["seq"]
+                  if mesh is not None and "seq" in mesh.axis_names else 1)
+    if seq_shards > 1:
+        if max_seq_len % seq_shards:
+            raise ValueError(
+                f"the seq mesh axis size ({seq_shards}) must divide "
+                f"max_seq_len ({max_seq_len})")
+        train_model = GPT2DoubleHeads(gcfg, seq_axis="seq",
+                                      seq_shards=seq_shards)
+        loss_train = make_gpt2_train_loss(train_model, cfg.lm_coef,
+                                          cfg.mc_coef, seq_axis="seq",
+                                          seq_shards=seq_shards)
+        print(f"sequence parallelism: ring attention over {seq_shards} "
+              "shards")
+    else:
+        loss_train = make_gpt2_train_loss(model, cfg.lm_coef, cfg.mc_coef)
+    # validation always runs the dense model (same param pytree)
     loss_val = make_gpt2_val_loss(model)
     runtime = FedRuntime(cfg, params, loss_train, loss_val,
                          num_clients=train_ds.num_clients,
-                         mesh=build_mesh(cfg))
+                         mesh=mesh,
+                         seq_spec=(PERSONA_SEQ_SPEC if seq_shards > 1
+                                   else None))
     state = runtime.init_state()
     print(f"grad size {runtime.cfg.grad_size}; "
           f"initialized in {timer():.2f}s")

@@ -14,6 +14,7 @@ from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 def _cast(tree, dtype):
@@ -22,22 +23,45 @@ def _cast(tree, dtype):
         else t, tree)
 
 
-def _gpt2_losses(model, params, batch, mask):
-    """Shared DoubleHeads forward: (lm_nll_per_token, mc_loss, mc_acc)."""
+def _gpt2_losses(model, params, batch, mask, seq_axis=None, seq_shards=1):
+    """Shared DoubleHeads forward: (lm_nll_per_token, mc_loss, mc_acc).
+
+    ``seq_axis``: set when the model runs seq-sharded inside a shard_map
+    (ring attention). The next-token label shift then crosses shard
+    boundaries — each shard fetches its right neighbour's first label
+    column via ``ppermute`` — and the masked token means psum over the
+    axis, so every shard computes the identical GLOBAL loss (its gradient
+    contribution stays local to its tokens; the runtime sums shards)."""
     lm_logits, mc_logits = model.apply(
         params, batch["input_ids"], batch["mc_token_ids"],
         batch["token_type_ids"])
     m = mask.astype(jnp.float32)                      # (B,)
 
-    sh_logits = lm_logits[..., :-1, :]                # (B, C, S-1, V)
-    sh_labels = batch["lm_labels"][..., 1:]           # (B, C, S-1)
+    if seq_axis is None:
+        sh_logits = lm_logits[..., :-1, :]            # (B, C, S-1, V)
+        sh_labels = batch["lm_labels"][..., 1:]       # (B, C, S-1)
+    else:
+        # label for local position t is labels[t+1]; the last local
+        # position needs the NEXT shard's first label (the global last
+        # shard has no successor -> -100)
+        labels = batch["lm_labels"]
+        perm = [(i, (i - 1) % seq_shards) for i in range(seq_shards)]
+        nxt = lax.ppermute(labels[..., :1], seq_axis, perm)
+        is_last = lax.axis_index(seq_axis) == seq_shards - 1
+        nxt = jnp.where(is_last, -100, nxt)
+        sh_logits = lm_logits                         # (B, C, S_loc, V)
+        sh_labels = jnp.concatenate([labels[..., 1:], nxt], axis=-1)
     tok_valid = ((sh_labels != -100)
                  * m[:, None, None]).astype(jnp.float32)
     safe_labels = jnp.maximum(sh_labels, 0)
     logp = jax.nn.log_softmax(sh_logits)
     tok_nll = -jnp.take_along_axis(
         logp, safe_labels[..., None], axis=-1)[..., 0]
-    lm_loss = (tok_nll * tok_valid).sum() / jnp.maximum(tok_valid.sum(), 1.0)
+    num, den = (tok_nll * tok_valid).sum(), tok_valid.sum()
+    if seq_axis is not None:
+        num = lax.psum(num, seq_axis)
+        den = lax.psum(den, seq_axis)
+    lm_loss = num / jnp.maximum(den, 1.0)
 
     mc_logp = jax.nn.log_softmax(mc_logits, axis=-1)  # (B, C)
     mc_nll = -jnp.take_along_axis(
@@ -49,25 +73,32 @@ def _gpt2_losses(model, params, batch, mask):
     return lm_loss, mc_loss, acc
 
 
-def make_gpt2_train_loss(model, lm_coef: float = 1.0, mc_coef: float = 1.0):
+def make_gpt2_train_loss(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
+                         seq_axis=None, seq_shards: int = 1):
     """DoubleHeads training loss (reference gpt2_train.py:88-99):
     ``lm_coef * lm_loss + mc_coef * mc_loss`` where the LM loss is shifted
     cross-entropy over the gold candidate's reply tokens and the MC loss is
-    cross-entropy over candidates. Metrics: (mc accuracy,)."""
+    cross-entropy over candidates. Metrics: (mc accuracy,). Pass
+    ``seq_axis``/``seq_shards`` matching the model's when it runs
+    seq-sharded."""
 
     def loss_fn(params, batch, mask):
-        lm_loss, mc_loss, acc = _gpt2_losses(model, params, batch, mask)
+        lm_loss, mc_loss, acc = _gpt2_losses(
+            model, params, batch, mask, seq_axis=seq_axis,
+            seq_shards=seq_shards)
         return lm_coef * lm_loss + mc_coef * mc_loss, (acc,)
 
     return loss_fn
 
 
-def make_gpt2_val_loss(model):
+def make_gpt2_val_loss(model, seq_axis=None, seq_shards: int = 1):
     """Validation metrics (reference test_gpt2, gpt2_train.py:55-86):
     per-token LM NLL (=> ppl on the host) and MC accuracy."""
 
     def loss_fn(params, batch, mask):
-        lm_loss, _, acc = _gpt2_losses(model, params, batch, mask)
+        lm_loss, _, acc = _gpt2_losses(
+            model, params, batch, mask, seq_axis=seq_axis,
+            seq_shards=seq_shards)
         return lm_loss, (acc,)
 
     return loss_fn

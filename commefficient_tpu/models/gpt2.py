@@ -19,12 +19,14 @@ has no sequence parallelism (SURVEY.md §5).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax import lax
 
 NUM_SPECIAL_TOKENS = 5  # <bos> <eos> <speaker1> <speaker2> <pad>
 
@@ -115,8 +117,17 @@ class _ScanBody(nn.Module):
 
 
 class GPT2Backbone(nn.Module):
+    """``seq_axis``/``seq_shards``: when set, the module expects to run
+    INSIDE a shard_map whose mesh has that axis, with every (..., S, ...)
+    input already holding only the local S/seq_shards token shard: position
+    ids become global (offset by the shard index), and attention runs as
+    ring attention over the axis (parallel/ring.py) — the long-context
+    configuration the reference lacks entirely (SURVEY.md §5)."""
+
     cfg: GPT2Config
     attn_impl: Callable = dense_causal_attention
+    seq_axis: Optional[str] = None
+    seq_shards: int = 1
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, position_ids=None):
@@ -127,21 +138,31 @@ class GPT2Backbone(nn.Module):
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.n_positions, cfg.n_embd))
         if position_ids is None:
-            position_ids = jnp.arange(S)
+            if self.seq_axis is not None:
+                position_ids = (lax.axis_index(self.seq_axis) * S
+                                + jnp.arange(S))
+            else:
+                position_ids = jnp.arange(S)
         x = wte[input_ids] + wpe[position_ids]
         if token_type_ids is not None:
             x = x + wte[token_type_ids]
         x = x.astype(cfg.compute_dtype)
+        attn = self.attn_impl
+        if self.seq_axis is not None:
+            from commefficient_tpu.parallel.ring import ring_attention_inner
+            attn = functools.partial(ring_attention_inner,
+                                     axis_name=self.seq_axis,
+                                     num_shards=self.seq_shards)
         block_cls = nn.remat(Block) if cfg.remat else Block
         if cfg.scan_layers:
             scanned = nn.scan(
                 _ScanBody, variable_axes={"params": 0},
                 split_rngs={"params": True}, length=cfg.n_layer,
                 metadata_params={nn.meta.PARTITION_NAME: None})
-            x, _ = scanned(block_cls, cfg, self.attn_impl, name="h")(x, None)
+            x, _ = scanned(block_cls, cfg, attn, name="h")(x, None)
         else:
             for i in range(cfg.n_layer):
-                x = block_cls(cfg, self.attn_impl, name=f"h{i}")(x)
+                x = block_cls(cfg, attn, name=f"h{i}")(x)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          name="ln_f")(x)
         return x, wte
@@ -157,17 +178,39 @@ class GPT2DoubleHeads(nn.Module):
 
     cfg: GPT2Config
     attn_impl: Callable = dense_causal_attention
+    seq_axis: Optional[str] = None
+    seq_shards: int = 1
 
     @nn.compact
     def __call__(self, input_ids, mc_token_ids, token_type_ids=None):
         hidden, wte = GPT2Backbone(self.cfg, self.attn_impl,
+                                   seq_axis=self.seq_axis,
+                                   seq_shards=self.seq_shards,
                                    name="transformer")(
             input_ids, token_type_ids)
         lm_logits = (hidden @ wte.T.astype(hidden.dtype)).astype(jnp.float32)
-        mc_hidden = jnp.take_along_axis(
-            hidden, mc_token_ids[..., None, None], axis=-2)[..., 0, :]
-        mc_logits = nn.Dense(1, dtype=jnp.float32,
-                             name="mc_head")(mc_hidden)[..., 0]
+        # mc_head is bias-free: a bias on a 1-unit head shifts every
+        # candidate's logit equally, which the MC softmax is invariant to —
+        # and bias-freeness lets the seq-sharded branch psum LOGIT
+        # contributions (linear), so the kernel's gradient flows only from
+        # the owning shard's tokens instead of duplicating across the axis
+        mc_head = nn.Dense(1, use_bias=False, dtype=jnp.float32,
+                           name="mc_head")
+        if self.seq_axis is not None:
+            # mc_token_ids are GLOBAL positions; exactly one seq shard owns
+            # each and contributes; the psum replicates the logits
+            S = hidden.shape[-2]
+            local = mc_token_ids - lax.axis_index(self.seq_axis) * S
+            owned = (local >= 0) & (local < S)
+            li = jnp.clip(local, 0, S - 1)
+            contrib = jnp.take_along_axis(
+                hidden, li[..., None, None], axis=-2)[..., 0, :]
+            contrib = jnp.where(owned[..., None], contrib, 0.0)
+            mc_logits = lax.psum(mc_head(contrib)[..., 0], self.seq_axis)
+        else:
+            mc_hidden = jnp.take_along_axis(
+                hidden, mc_token_ids[..., None, None], axis=-2)[..., 0, :]
+            mc_logits = mc_head(mc_hidden)[..., 0]
         return lm_logits, mc_logits
 
 

@@ -81,7 +81,10 @@ class FedShardings:
 
     @property
     def dense_vec(self) -> NamedSharding:           # (d,)
-        return self._ns(self.axis)
+        # dense federated vectors shard over ALL mesh axes (on a 2-D
+        # ("clients","seq") mesh every device holds d/mesh.size), so the
+        # server's elementwise math uses the full machine
+        return self._ns(tuple(self.mesh.axis_names))
 
     @property
     def sketch_table(self) -> NamedSharding:        # (r, c)
@@ -103,12 +106,14 @@ class FedShardings:
         """Sharding pytree matching a FedState.
 
         Weight-dimension sharding of the dense (d,) vectors and the sketch
-        column axis is applied only when the dim divides the mesh axis —
+        column axis is applied only when the dim divides the device count —
         otherwise those leaves replicate (which is exactly the reference's
         layout: every process holds the full weight vector,
-        fed_aggregator.py:94-97). Per-client rows always shard (the runtime
-        pads num_clients up to a mesh multiple)."""
+        fed_aggregator.py:94-97). The runtime pads both num_clients and the
+        dense length up to mesh multiples, so in practice everything
+        shards."""
         n = self.mesh.shape[self.axis]
+        n_dense = self.mesh.size
 
         def leaf(path, like):
             name = path[0].name
@@ -120,7 +125,7 @@ class FedShardings:
                 if like.ndim == 2:       # sketch table (r, c)
                     return (self.sketch_table if like.shape[1] % n == 0
                             else self.replicated)
-                return (self.dense_vec if like.shape[0] % n == 0
+                return (self.dense_vec if like.shape[0] % n_dense == 0
                         else self.replicated)
             return self.replicated  # step, rng
         return jax.tree_util.tree_map_with_path(leaf, state_like)
