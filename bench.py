@@ -10,7 +10,11 @@ update — and reports images/second. ``vs_baseline`` is the ratio against a
 ~24 epochs x ~25 s on one V100; the reference publishes no numbers of its
 own — BASELINE.md).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu"}.
+``vs_baseline`` divides by a NOMINAL (not measured) single-GPU anchor;
+``mfu`` is the measured model-FLOPs utilization — XLA's own flop count for
+the compiled round over wall-clock x peak bf16 FLOP/s — and is the number
+to trust.
 """
 
 from __future__ import annotations
@@ -21,11 +25,9 @@ import time
 
 import numpy as np
 
+from bench_gpt2 import compiled_round_flops, log, peak_flops
+
 NOMINAL_SINGLE_GPU_IMG_PER_SEC = 2000.0
-
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
 
 
 def main():
@@ -46,12 +48,16 @@ def main():
         num_workers=W, local_batch_size=B,
         k=50_000, num_rows=5, num_cols=500_000, num_blocks=20,
         num_clients=100, track_bytes=False,
-        # TPU-tuned selects: approx_max_k (0.95 recall) for the top-k
+        # TPU-tuned select: approx_max_k (0.95 recall) for the top-k
         # sparsification — itself an approximation — instead of a 20x
-        # slower exact sort-based select; bf16 sketch transform (noise
-        # ~1e-3, far under the sketch's own estimation error at this c/d)
-        approx_topk=True, sketch_dtype="bfloat16",
+        # slower exact sort-based select. Sketch: the default circulant
+        # impl (fp32 tables).
+        approx_topk=True,
     )
+    # persistent compile cache: the cost-analysis lower+compile after the
+    # timing loop would otherwise pay a full second compilation
+    from commefficient_tpu.config import enable_compilation_cache
+    enable_compilation_cache(cfg)
 
     model = models.ResNet9(num_classes=10)
     x0 = jnp.ones((1, 32, 32, 3), jnp.float32)
@@ -94,12 +100,30 @@ def main():
     loss = float(np.asarray(metrics["results"][0]).mean())
     log(f"final mean client loss {loss:.4f}")
 
-    print(json.dumps({
+    flops = compiled_round_flops(
+        runtime, state,
+        (client_ids, batch, mask, jnp.asarray(lr, jnp.float32), runtime.cs))
+    peak = peak_flops(jax.devices()[0])
+    mfu = (flops * n_rounds / dt) / peak
+    log(f"round FLOPs {flops:.3e}, peak {peak:.0f}, MFU {mfu:.3f}")
+    result = {
         "metric": "cifar10_sketch_round_throughput",
         "value": round(ips, 1),
         "unit": "images/sec",
         "vs_baseline": round(ips / NOMINAL_SINGLE_GPU_IMG_PER_SEC, 3),
-    }))
+        "mfu": round(mfu, 4) if np.isfinite(mfu) else None,
+    }
+    # secondary metric: the GPT-2 (124M) sketched round, so the driver's
+    # BENCH record captures both benchmarks (best-effort — the headline
+    # CIFAR metric must survive a GPT-2 failure, e.g. an OOM on a small
+    # chip)
+    try:
+        import bench_gpt2
+        g = bench_gpt2.run()
+        result["gpt2"] = g
+    except Exception as e:  # pragma: no cover
+        log(f"WARNING: GPT-2 bench failed ({e})")
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -77,6 +77,13 @@ class FedRuntime:
         self._seq_shards = (mesh.shape["seq"] if self._seq_axis else 1)
         self._seq_spec = seq_spec or {}
         if self._seq_axis:
+            if not self._seq_spec:
+                raise ValueError(
+                    "the mesh has a 'seq' axis but no seq_spec was given: "
+                    "without one the batch replicates over seq and every "
+                    "shard silently duplicates the full forward/backward. "
+                    "Pass seq_spec (and a seq-sharded loss/model, see "
+                    "gpt2_train.py), or drop the seq axis from mesh_axes.")
             # the per-shard client pipeline must be LINEAR in the gradient
             # (shards sum): modes with per-client nonlinearities are out
             if cfg.mode not in ("uncompressed", "true_topk", "sketch"):

@@ -1,0 +1,220 @@
+"""Real-format data fixtures (VERDICT r1 next #4): every non-synthetic
+ingest branch — CIFAR pickle dirs, the PersonaChat corpus json + real GPT-2
+BPE tokenizer, and the ImageNet image tree + driver recipe — exercised
+against tiny fixtures in the reference's exact on-disk formats."""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+# ---------------------------------------------------------------- CIFAR
+
+
+def _write_cifar_pickles(root, num_classes=10, per_batch=20):
+    """Tiny cifar-10-batches-py/ in the standard python-pickle schema:
+    dicts with b'data' (N, 3072) uint8 row-major CHW and b'labels'."""
+    d = os.path.join(root, "cifar-10-batches-py")
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.RandomState(0)
+
+    def batch(seed):
+        r = np.random.RandomState(seed)
+        data = r.randint(0, 255, (per_batch, 3072), dtype=np.uint8)
+        labels = [int(x) for x in r.randint(0, num_classes, per_batch)]
+        return {b"data": data, b"labels": labels}
+
+    for i in range(1, 6):
+        with open(os.path.join(d, f"data_batch_{i}"), "wb") as f:
+            pickle.dump(batch(i), f)
+    with open(os.path.join(d, "test_batch"), "wb") as f:
+        pickle.dump(batch(99), f)
+    return d
+
+
+def test_cifar_pickle_ingest(tmp_path):
+    """The real-pickle branch (reference fed_cifar.py layout): images are
+    split by label into per-class clients and round-trip exactly."""
+    from commefficient_tpu.data.fed_cifar import FedCIFAR10
+
+    _write_cifar_pickles(str(tmp_path))
+    ds = FedCIFAR10(str(tmp_path))        # synthetic=None, real data found
+    assert ds.num_clients == 10
+    assert len(ds) == 100                 # 5 batches x 20
+    # reconstruct the expected class partition from the raw pickles
+    raw_imgs, raw_labels = [], []
+    for i in range(1, 6):
+        with open(str(tmp_path / "cifar-10-batches-py" / f"data_batch_{i}"),
+                  "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        raw_imgs.append(d[b"data"].reshape(-1, 3, 32, 32)
+                        .transpose(0, 2, 3, 1))
+        raw_labels.append(np.asarray(d[b"labels"]))
+    raw_imgs = np.concatenate(raw_imgs)
+    raw_labels = np.concatenate(raw_labels)
+    counts = np.bincount(raw_labels, minlength=10)
+    np.testing.assert_array_equal(ds.images_per_client, counts)
+    # flat order is class-sorted; client id == class (reference
+    # fed_cifar.py:78-84)
+    b = ds.gather(np.arange(len(ds)))
+    np.testing.assert_array_equal(
+        b["target"], np.repeat(np.arange(10), counts))
+    # every class-0 image from the raw batches appears in client 0's slab
+    class0 = raw_imgs[raw_labels == 0]
+    np.testing.assert_array_equal(
+        np.sort(b["image"][: counts[0]].reshape(counts[0], -1), axis=0),
+        np.sort(class0.reshape(counts[0], -1), axis=0))
+    # val split loads too
+    val = FedCIFAR10(str(tmp_path), train=False)
+    assert len(val) == 20
+
+
+# -------------------------------------------------------------- Persona
+
+
+PERSONA_FIXTURE = {
+    "train": [
+        {"personality": ["i love cats .", "i am a chef ."],
+         "utterances": [
+             {"history": ["hello how are you ?"],
+              "candidates": ["bad answer here .",
+                             "great , cooking dinner now ."]},
+             {"history": ["hello how are you ?",
+                          "great , cooking dinner now .",
+                          "what do you cook ?"],
+              "candidates": ["i have no idea .",
+                             "mostly fish for my cats ."]},
+         ]},
+        {"personality": ["i run marathons .", "i live in ohio ."],
+         "utterances": [
+             {"history": ["hi there !"],
+              "candidates": ["wrong reply .",
+                             "hi , just back from a run ."]},
+         ]},
+    ],
+    "valid": [
+        {"personality": ["i play guitar ."],
+         "utterances": [
+             {"history": ["what are your hobbies ?"],
+              "candidates": ["none of that .", "music , mostly guitar ."]},
+         ]},
+    ],
+}
+
+
+def _write_bpe_fixture(d):
+    """Minimal on-disk GPT-2 BPE: full byte-level alphabet vocab + no
+    merges — a valid tokenizer the real `GPT2Tokenizer.from_pretrained`
+    branch loads offline."""
+    from transformers.models.gpt2.tokenization_gpt2 import bytes_to_unicode
+
+    os.makedirs(d, exist_ok=True)
+    alphabet = list(bytes_to_unicode().values())
+    vocab = {ch: i for i, ch in enumerate(alphabet)}
+    vocab["<|endoftext|>"] = len(vocab)
+    with open(os.path.join(d, "vocab.json"), "w") as f:
+        json.dump(vocab, f)
+    with open(os.path.join(d, "merges.txt"), "w") as f:
+        f.write("#version: 0.2\n")
+    return d
+
+
+def test_persona_real_corpus_with_real_bpe(tmp_path):
+    """The real-corpus branch (reference fed_persona.py:23-28, 31-392) +
+    the real GPT-2 BPE tokenizer branch (get_tokenizer, reference
+    fed_persona.py:63-75), end to end from files on disk."""
+    from commefficient_tpu.data.fed_persona import FedPERSONA, get_tokenizer
+
+    tok_dir = _write_bpe_fixture(str(tmp_path / "bpe"))
+    tok = get_tokenizer(tok_dir)
+    from transformers import GPT2Tokenizer
+    assert isinstance(tok, GPT2Tokenizer)      # NOT the Hash fallback
+    # the 5 reference special tokens were added (gpt2_train.py:101-112)
+    for t in ("<bos>", "<eos>", "<speaker1>", "<speaker2>", "<pad>"):
+        assert tok.convert_tokens_to_ids(t) is not None
+
+    data_dir = str(tmp_path / "persona")
+    os.makedirs(data_dir)
+    with open(os.path.join(data_dir, "personachat_self_original.json"),
+              "w") as f:
+        json.dump(PERSONA_FIXTURE, f)
+
+    ds = FedPERSONA(data_dir, tokenizer=tok, max_seq_len=96)
+    # clients = distinct personalities
+    assert ds.num_clients == 2
+    assert ds.images_per_client.tolist() == [2, 1]
+    b = ds.gather(np.arange(3))
+    assert b["input_ids"].shape == (3, 2, 96)
+    # gold candidate is last (reference convention)
+    np.testing.assert_array_equal(b["mc_label"], [1, 1, 1])
+    # the packed tokens decode back to the corpus text: find the gold
+    # reply of the first utterance inside candidate 1's sequence
+    seq = tok.decode([t for t in b["input_ids"][0, 1]
+                      if t != tok.convert_tokens_to_ids("<pad>")])
+    assert "great , cooking dinner now ." in seq
+    assert "i love cats ." in seq              # persona prefix
+    # prep config records the real corpus + tokenizer identity
+    with open(os.path.join(data_dir, "FedPERSONA_persona_prep.json")) as f:
+        prep = json.load(f)
+    assert prep["corpus"] == "real"
+    val = FedPERSONA(data_dir, train=False, tokenizer=tok, max_seq_len=96)
+    assert len(val) == 1                       # one valid-split utterance
+
+
+# ------------------------------------------------------------- ImageNet
+
+
+def _write_imagenet_tree(root, wnids=("n01440764", "n01443537"), per=3,
+                         size=48):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    for split, n in (("train", per), ("val", 1)):
+        for wnid in wnids:
+            d = os.path.join(root, split, wnid)
+            os.makedirs(d, exist_ok=True)
+            for i in range(n):
+                arr = rng.randint(0, 255, (size + 10, size, 3),
+                                  dtype=np.uint8)
+                Image.fromarray(arr).save(
+                    os.path.join(d, f"{wnid}_{i}.JPEG"), "JPEG")
+
+
+def test_imagenet_tree_ingest(tmp_path):
+    """The real image-tree branch (reference fed_imagenet.py:12-76): one
+    wnid class per client, decoded + resized at prepare time."""
+    from commefficient_tpu.data.fed_imagenet import FedImageNet
+
+    _write_imagenet_tree(str(tmp_path))
+    ds = FedImageNet(str(tmp_path), image_size=32)
+    assert ds.num_clients == 2
+    assert ds.images_per_client.tolist() == [3, 3]
+    b = ds.gather(np.arange(6))
+    assert b["image"].shape == (6, 32, 32, 3)
+    assert b["image"].dtype == np.uint8
+    np.testing.assert_array_equal(b["target"], [0, 0, 0, 1, 1, 1])
+    val = FedImageNet(str(tmp_path), train=False, image_size=32)
+    assert len(val) == 2
+
+
+@pytest.mark.slow
+def test_imagenet_recipe_smoke(tmp_path):
+    """scripts/imagenet.sh --test: the FixupResNet50 recipe executes one
+    real federated round end to end (tiny synthetic tree, single device)."""
+    _write_imagenet_tree(str(tmp_path), per=2, size=40)
+    env = dict(os.environ,
+               DATASET_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        ["bash", "scripts/imagenet.sh", "--test",
+         "--num_workers", "2", "--num_clients", "2",
+         "--local_batch_size", "2", "--valid_batch_size", "2",
+         "--checkpoint_every", "0", "--checkpoint_path",
+         str(tmp_path / "ck"), "--mesh_shape", ""],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=1800)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "Total Upload" in out.stdout
