@@ -67,6 +67,9 @@ class FedConfig:
     finetune_path: str = "./finetune"
     finetuned_from: Optional[str] = None
     do_batchnorm: bool = False
+    # images per class for the synthetic CIFAR fallback (no-network runs);
+    # the real pickles/tree take precedence when present
+    synthetic_per_class: int = 64
     num_results_train: int = 2
     num_results_val: int = 2
 
@@ -263,6 +266,7 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                    choices=list(FED_DATASETS))
     p.add_argument("--dataset_dir", type=str, default="./dataset")
     p.add_argument("--batchnorm", action="store_true", dest="do_batchnorm")
+    p.add_argument("--synthetic_per_class", type=int, default=64)
 
     p.add_argument("--k", type=int, default=50_000)
     p.add_argument("--num_cols", type=int, default=500_000)

@@ -75,6 +75,11 @@ class FedRuntime:
         self._seq_axis = ("seq" if (mesh is not None
                                     and "seq" in mesh.axis_names) else None)
         self._seq_shards = (mesh.shape["seq"] if self._seq_axis else 1)
+        if self._seq_shards == 1:
+            # a size-1 seq axis is a degenerate layout, not sequence
+            # parallelism — treat it as absent (no seq_spec required, no
+            # mode restrictions, no gradient rescale)
+            self._seq_axis = None
         self._seq_spec = seq_spec or {}
         if self._seq_axis:
             if not self._seq_spec:

@@ -112,8 +112,10 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
 def build_datasets(cfg: FedConfig):
     ds_cls = get_dataset(cfg.dataset_name)
     kw = {}
+    if cfg.dataset_name in ("CIFAR10", "CIFAR100", "ImageNet"):
+        kw["synthetic_per_class"] = cfg.synthetic_per_class
     if cfg.do_test:
-        kw = {"synthetic": True}
+        kw["synthetic"] = True
     train_ds = ds_cls(cfg.dataset_dir, train=True, do_iid=cfg.do_iid,
                       num_clients=cfg.num_clients,
                       transform=transforms_for(cfg.dataset_name, True,

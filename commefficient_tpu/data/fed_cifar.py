@@ -19,6 +19,7 @@ exercisable end-to-end.
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 from typing import Optional
@@ -27,13 +28,24 @@ import numpy as np
 
 from commefficient_tpu.data.fed_dataset import FedDataset
 
+# version tag for the synthetic generator's semantics; "shared-v2" =
+# train/val share class prototypes (val differs only in noise)
+_SYNTH_PROTOS = "shared-v2"
+
 
 def _synthetic_cifar(num_classes: int, per_class: int, img_hw: int = 32,
-                     seed: int = 1234):
+                     seed: int = 1234, proto_seed: int = 777):
     """Class-structured gaussian images: each class has a distinct mean
-    pattern so that models can actually fit the data in tests."""
+    pattern so that models can actually fit the data in tests.
+
+    The class prototypes come from ``proto_seed`` (FIXED by default) while
+    per-image noise comes from ``seed`` — so a train split (seed A) and a
+    val split (seed B) describe the SAME classes with fresh noise, making
+    validation accuracy a real generalization measure instead of an
+    unlearnable-by-construction one."""
+    protos = np.random.RandomState(proto_seed).randint(
+        0, 255, size=(num_classes, img_hw, img_hw, 3))
     rng = np.random.RandomState(seed)
-    protos = rng.randint(0, 255, size=(num_classes, img_hw, img_hw, 3))
     images, targets = [], []
     for c in range(num_classes):
         noise = rng.randint(-60, 60, size=(per_class, img_hw, img_hw, 3))
@@ -59,7 +71,39 @@ class FedCIFAR10(FedDataset):
         # data is absent — the expected no-network verification path.
         self._synthetic = synthetic
         self._synthetic_per_class = synthetic_per_class
+        # Prep-config invalidation for OUR (prefixed) prepared stats:
+        # synthetic preps record their size + generator version, so
+        # changing --synthetic_per_class (or a generator fix) re-prepares
+        # instead of silently reusing stale arrays, and a synthetic prep
+        # is replaced once the real raw source appears. Marker-less stats
+        # are left alone (they may be real-data preps whose raw source was
+        # since removed — regenerating would destroy them) with a warning
+        # when a synthetic prep was requested.
+        dataset_dir = args[0] if args else kw.get("dataset_dir")
+        pref = os.path.join(dataset_dir,
+                            f"stats_{type(self).__name__}.json")
+        if os.path.exists(pref):
+            try:
+                with open(pref) as f:
+                    marker = json.load(f).get("synthetic")
+            except Exception:
+                marker = None
+            want_syn = (synthetic is True
+                        or (synthetic is None
+                            and not self._has_real_source(dataset_dir)))
+            expected = ({"per_class": synthetic_per_class,
+                         "protos": _SYNTH_PROTOS} if want_syn else None)
+            if marker is not None and marker != expected:
+                os.unlink(pref)       # ours and stale: re-prepare
+            elif marker is None and want_syn:
+                print(f"WARNING: reusing prepared data under {dataset_dir} "
+                      "that predates synthetic-prep markers; delete "
+                      f"{pref} to regenerate with the current synthetic "
+                      "settings")
         super().__init__(*args, **kw)
+
+    def _has_real_source(self, dataset_dir: str) -> bool:
+        return os.path.isdir(os.path.join(dataset_dir, self._pickle_dir))
 
     # --------------------------------------------------------- preparation
 
@@ -76,6 +120,7 @@ class FedCIFAR10(FedDataset):
 
     def _prepare(self, download: bool = False) -> None:
         pickled = os.path.join(self.dataset_dir, self._pickle_dir)
+        marker = None
         if os.path.isdir(pickled) and not self._synthetic:
             train_images, train_targets = self._load_pickles(
                 self._train_files)
@@ -94,6 +139,8 @@ class FedCIFAR10(FedDataset):
             test_images, test_targets = _synthetic_cifar(
                 self.num_classes, max(self._synthetic_per_class // 4, 2),
                 seed=4321)
+            marker = {"per_class": self._synthetic_per_class,
+                      "protos": _SYNTH_PROTOS}
 
         os.makedirs(self.dataset_dir, exist_ok=True)
         images_per_client = []
@@ -103,8 +150,8 @@ class FedCIFAR10(FedDataset):
             np.save(self.client_fn(c), train_images[sel])
         np.savez(self.test_fn(), test_images=test_images,
                  test_targets=test_targets)
-        self.write_stats(images_per_client,
-                         len(test_targets))
+        self.write_stats(images_per_client, len(test_targets),
+                         **({"synthetic": marker} if marker else {}))
 
     # ------------------------------------------------------------- loading
 

@@ -34,6 +34,9 @@ class FedImageNet(FedCIFAR10):
         self._synthetic_num_classes = synthetic_num_classes
         super().__init__(*args, **kw)
 
+    def _has_real_source(self, dataset_dir: str) -> bool:
+        return os.path.isdir(os.path.join(dataset_dir, "train"))
+
     def _prepare(self, download: bool = False) -> None:
         train_root = os.path.join(self.dataset_dir, "train")
         if os.path.isdir(train_root):
@@ -58,8 +61,10 @@ class FedImageNet(FedCIFAR10):
             np.save(self.client_fn(c), train_images[sel])
         np.savez(self.test_fn(), test_images=test_images,
                  test_targets=test_targets)
-        self.write_stats(images_per_client,
-                         len(test_targets))
+        from commefficient_tpu.data.fed_cifar import _SYNTH_PROTOS
+        self.write_stats(images_per_client, len(test_targets),
+                         synthetic={"per_class": self._synthetic_per_class,
+                                    "protos": _SYNTH_PROTOS})
 
     def _prepare_from_tree(self, train_root: str) -> None:
         from PIL import Image  # lazy: PIL only needed for real preparation

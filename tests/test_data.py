@@ -38,13 +38,13 @@ def test_cifar_partition(cifar_dir):
 
 def test_cifar_reload_from_disk(cifar_dir):
     d, _ = cifar_dir
-    ds2 = FedCIFAR10(d)  # stats.json exists; no synthetic needed
+    ds2 = FedCIFAR10(d, synthetic_per_class=16)  # prepared stats reused
     assert len(ds2) == 160
 
 
 def test_cifar_val(cifar_dir):
     d, _ = cifar_dir
-    val = FedCIFAR10(d, train=False)
+    val = FedCIFAR10(d, train=False, synthetic_per_class=16)
     assert len(val) == val.num_val_images > 0
     b = val.gather(np.arange(4))
     assert b["image"].shape == (4, 32, 32, 3)
@@ -52,7 +52,7 @@ def test_cifar_val(cifar_dir):
 
 def test_data_per_client_sharding(cifar_dir):
     _, _ = cifar_dir
-    ds = FedCIFAR10(cifar_dir[0], num_clients=20)
+    ds = FedCIFAR10(cifar_dir[0], num_clients=20, synthetic_per_class=16)
     per = ds.data_per_client
     assert len(per) == 20 and per.sum() == 160
     # each class split across 2 synthetic clients (reference
@@ -61,7 +61,8 @@ def test_data_per_client_sharding(cifar_dir):
 
 
 def test_iid_partition(cifar_dir):
-    ds = FedCIFAR10(cifar_dir[0], do_iid=True, num_clients=7)
+    ds = FedCIFAR10(cifar_dir[0], do_iid=True, num_clients=7,
+                    synthetic_per_class=16)
     per = ds.data_per_client
     assert per.sum() == 160 and len(per) == 7
     assert per.max() - per.min() <= 1
@@ -134,3 +135,37 @@ def test_emnist_synthetic(tmp_path):
     t = transforms_for("EMNIST", train=True)
     out = t(b)
     assert out["image"].shape == (6, 28, 28, 1)
+
+
+def test_synthetic_prep_invalidation(tmp_path):
+    """Changing --synthetic_per_class (or the generator version) must
+    re-prepare a synthetic dir instead of silently reusing stale arrays;
+    marker-less (real-data era) stats are preserved."""
+    from commefficient_tpu.data.fed_cifar import FedCIFAR10
+
+    ds = FedCIFAR10(str(tmp_path), synthetic=True, synthetic_per_class=8)
+    assert len(ds) == 80
+    # same size: reused
+    again = FedCIFAR10(str(tmp_path), synthetic=True, synthetic_per_class=8)
+    assert len(again) == 80
+    # different size: re-prepared
+    bigger = FedCIFAR10(str(tmp_path), synthetic=True,
+                        synthetic_per_class=16)
+    assert len(bigger) == 160
+
+
+def test_synthetic_val_shares_prototypes():
+    """Train and val synthetic splits must describe the SAME classes
+    (different noise only) — otherwise validation accuracy is capped at
+    chance by construction (the r1 artifact-run bug)."""
+    from commefficient_tpu.data.fed_cifar import _synthetic_cifar
+
+    tr_img, tr_t = _synthetic_cifar(4, 8, seed=1234)
+    va_img, va_t = _synthetic_cifar(4, 8, seed=4321)
+    # per-class means across splits are close (same prototype)...
+    for c in range(4):
+        m_tr = tr_img[tr_t == c].astype(float).mean(axis=0)
+        m_va = va_img[va_t == c].astype(float).mean(axis=0)
+        assert np.abs(m_tr - m_va).mean() < 20
+    # ...but the images themselves differ (fresh noise)
+    assert np.abs(tr_img.astype(float) - va_img.astype(float)).mean() > 10

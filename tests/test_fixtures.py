@@ -20,7 +20,6 @@ def _write_cifar_pickles(root, num_classes=10, per_batch=20):
     dicts with b'data' (N, 3072) uint8 row-major CHW and b'labels'."""
     d = os.path.join(root, "cifar-10-batches-py")
     os.makedirs(d, exist_ok=True)
-    rng = np.random.RandomState(0)
 
     def batch(seed):
         r = np.random.RandomState(seed)
@@ -133,9 +132,13 @@ def test_persona_real_corpus_with_real_bpe(tmp_path):
     tok = get_tokenizer(tok_dir)
     from transformers import GPT2Tokenizer
     assert isinstance(tok, GPT2Tokenizer)      # NOT the Hash fallback
-    # the 5 reference special tokens were added (gpt2_train.py:101-112)
-    for t in ("<bos>", "<eos>", "<speaker1>", "<speaker2>", "<pad>"):
-        assert tok.convert_tokens_to_ids(t) is not None
+    # the 5 reference special tokens were added (gpt2_train.py:101-112):
+    # each resolves to a REAL id (convert_tokens_to_ids returns unk for
+    # unknown tokens, so compare against it), all distinct
+    ids = [tok.convert_tokens_to_ids(t) for t in
+           ("<bos>", "<eos>", "<speaker1>", "<speaker2>", "<pad>")]
+    assert tok.unk_token_id not in ids
+    assert len(set(ids)) == 5
 
     data_dir = str(tmp_path / "persona")
     os.makedirs(data_dir)
