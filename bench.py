@@ -12,9 +12,11 @@ own — BASELINE.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu"}.
 ``vs_baseline`` divides by a NOMINAL (not measured) single-GPU anchor;
-``mfu`` is the measured model-FLOPs utilization — XLA's own flop count for
-the compiled round over wall-clock x peak bf16 FLOP/s — and is the number
-to trust.
+``mfu`` is the measured model-FLOPs utilization — the MODEL's fwd+bwd
+FLOPs for the round's images (XLA cost analysis of the bare
+value_and_grad; the sketch/server ops the round also executes are real
+work but not model FLOPs) over wall-clock x peak bf16 FLOP/s — and is
+the number to trust.
 """
 
 from __future__ import annotations

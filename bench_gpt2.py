@@ -54,22 +54,6 @@ def peak_flops(device) -> float:
     return 197e12
 
 
-def compiled_round_flops(runtime, state, args) -> float:
-    """XLA's flop count for one compiled federated round. CAVEAT: XLA
-    counts each ``lax.scan`` body ONCE (not x trip count), so any round
-    containing scans (microbatching, scan-over-layers) under-reports —
-    use an analytic model-FLOPs formula there (``gpt2_model_flops``)."""
-    try:
-        compiled = runtime._round.lower(state, *args).compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, list):   # some backends wrap per-computation
-            cost = cost[0]
-        return float(cost["flops"])
-    except Exception as e:
-        log(f"WARNING: cost analysis unavailable ({e})")
-        return float("nan")
-
-
 def gpt2_model_flops(gcfg, tokens: int, S: int) -> float:
     """Analytic fwd+bwd model FLOPs for ``tokens`` tokens of GPT-2 at
     sequence length S (2 FLOPs per MAC; backward = 2x forward):

@@ -91,8 +91,7 @@ class FedCIFAR10(FedDataset):
             want_syn = (synthetic is True
                         or (synthetic is None
                             and not self._has_real_source(dataset_dir)))
-            expected = ({"per_class": synthetic_per_class,
-                         "protos": _SYNTH_PROTOS} if want_syn else None)
+            expected = self._synth_marker() if want_syn else None
             if marker is not None and marker != expected:
                 os.unlink(pref)       # ours and stale: re-prepare
             elif marker is None and want_syn:
@@ -104,6 +103,12 @@ class FedCIFAR10(FedDataset):
 
     def _has_real_source(self, dataset_dir: str) -> bool:
         return os.path.isdir(os.path.join(dataset_dir, self._pickle_dir))
+
+    def _synth_marker(self) -> dict:
+        """Everything a synthetic prep bakes into its arrays — ANY field
+        change must invalidate the cache (subclasses add their knobs)."""
+        return {"per_class": self._synthetic_per_class,
+                "protos": _SYNTH_PROTOS}
 
     # --------------------------------------------------------- preparation
 
@@ -139,8 +144,7 @@ class FedCIFAR10(FedDataset):
             test_images, test_targets = _synthetic_cifar(
                 self.num_classes, max(self._synthetic_per_class // 4, 2),
                 seed=4321)
-            marker = {"per_class": self._synthetic_per_class,
-                      "protos": _SYNTH_PROTOS}
+            marker = self._synth_marker()
 
         os.makedirs(self.dataset_dir, exist_ok=True)
         images_per_client = []

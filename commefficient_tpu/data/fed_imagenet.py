@@ -37,6 +37,13 @@ class FedImageNet(FedCIFAR10):
     def _has_real_source(self, dataset_dir: str) -> bool:
         return os.path.isdir(os.path.join(dataset_dir, "train"))
 
+    def _synth_marker(self) -> dict:
+        # num_classes and image_size are baked into the synthetic arrays
+        # too — changing either must re-prepare
+        return dict(super()._synth_marker(),
+                    num_classes=self._synthetic_num_classes,
+                    image_size=self.image_size)
+
     def _prepare(self, download: bool = False) -> None:
         train_root = os.path.join(self.dataset_dir, "train")
         if os.path.isdir(train_root):
@@ -61,10 +68,8 @@ class FedImageNet(FedCIFAR10):
             np.save(self.client_fn(c), train_images[sel])
         np.savez(self.test_fn(), test_images=test_images,
                  test_targets=test_targets)
-        from commefficient_tpu.data.fed_cifar import _SYNTH_PROTOS
         self.write_stats(images_per_client, len(test_targets),
-                         synthetic={"per_class": self._synthetic_per_class,
-                                    "protos": _SYNTH_PROTOS})
+                         synthetic=self._synth_marker())
 
     def _prepare_from_tree(self, train_root: str) -> None:
         from PIL import Image  # lazy: PIL only needed for real preparation

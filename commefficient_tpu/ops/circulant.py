@@ -104,16 +104,21 @@ class CirculantSketch:
     def empty_table(self, dtype=jnp.float32) -> jax.Array:
         return jnp.zeros(self.table_shape, dtype)
 
+    def _sign_of(self, row: int, idx: jax.Array) -> jax.Array:
+        """±1 sign of global coordinates ``idx`` in ``row`` — the ONE
+        definition of the sign stream (murmur mixer, ops/sketch.py);
+        encode, decode and encode_at must all agree on it."""
+        h = _mix32(idx.astype(_U32) * self.sign_keys[row]
+                   + _U32(0x9E3779B9))
+        return 1.0 - 2.0 * (h >> 31).astype(jnp.float32)
+
     def _signs(self, row: int, b0: int = 0,
                nb: Optional[int] = None) -> jax.Array:
-        """±1 signs for blocks [b0, b0+nb) of one row, derived on the fly
-        from the shared murmur mixer (ops/sketch.py) — no (r, d) table, and
-        decode chunks only ever materialize their own block range."""
+        """±1 signs for blocks [b0, b0+nb) of one row — no (r, d) table,
+        and decode chunks only ever materialize their own block range."""
         nb = self.m - b0 if nb is None else nb
         idx = b0 * self.c + jnp.arange(nb * self.c, dtype=_U32)
-        h = _mix32(idx * self.sign_keys[row] + _U32(0x9E3779B9))
-        return (1.0 - 2.0 * (h >> 31).astype(jnp.float32)).reshape(
-            nb, self.c)
+        return self._sign_of(row, idx).reshape(nb, self.c)
 
     # ---------------------------------------------------------------- ops
 
@@ -152,11 +157,21 @@ class CirculantSketch:
         return jnp.stack(rows)
 
     def encode_at(self, vec: jax.Array, idx: jax.Array) -> jax.Array:
-        """Encode a k-sparse vector given its support. The dense encode is
-        already bandwidth-bound and ~2 ms, so sparsity buys nothing — call
-        it directly (vec is zero outside idx by contract)."""
-        del idx
-        return self.encode(vec)
+        """Encode a k-sparse vector given its support indices: equals
+        ``encode(vec)`` when vec is zero outside ``idx``, at O(k·r)
+        scatter-add cost instead of the O(d·r) roll pass (~2 ms vs ~87 ms
+        at d=124M, k=50k — this runs every round for the server's
+        error-feedback re-encode). Bucket of global coordinate i in row j:
+        (i mod c + shifts[j][i // c]) mod c; signs from the same mixer as
+        ``_signs``."""
+        vals = vec[idx]
+        rows = []
+        for j in range(self.r):
+            s = jnp.asarray(self.shifts[j], jnp.int32)[idx // self.c]
+            buckets = (idx.astype(jnp.int32) % self.c + s) % self.c
+            rows.append(jax.ops.segment_sum(self._sign_of(j, idx) * vals,
+                                            buckets, num_segments=self.c))
+        return jnp.stack(rows)
 
     def decode(self, table: jax.Array) -> jax.Array:
         assert table.shape == self.table_shape, (table.shape,
