@@ -138,9 +138,13 @@ class FedModel:
         return losses, accs, download, upload
 
     def _call_val(self, data):
+        # device-residency discipline (same as cv_train.run_validation):
+        # per-chunk sums ACCUMULATE ON DEVICE and the host fetches once at
+        # the end — a fetch inside the loop costs a full host<->device
+        # round-trip per chunk on the high-latency tunnel runtime
         n = len(next(iter(data.values())))
         vb = self.cfg.valid_batch_size
-        losses, accs, weights = [], [], []
+        acc_sums = None
         for start in range(0, n, vb):
             idx = np.arange(start, min(start + vb, n))
             pad = vb - len(idx)
@@ -151,13 +155,14 @@ class FedModel:
             results, n_valid = self.runtime.val(
                 self.state, {k: jnp.asarray(v) for k, v in chunk.items()},
                 jnp.asarray(mask))
-            w = float(n_valid)
-            losses.append(float(results[0]) * w)
-            accs.append(float(results[1]) * w)
-            weights.append(w)
-        total = max(sum(weights), 1.0)
-        return (np.array([sum(losses) / total]),
-                np.array([sum(accs) / total]))
+            contrib = jnp.stack([results[0] * n_valid,
+                                 results[1] * n_valid, n_valid])
+            acc_sums = contrib if acc_sums is None else acc_sums + contrib
+        sums = (np.asarray(acc_sums) if acc_sums is not None
+                else np.zeros(3))
+        total = max(float(sums[2]), 1.0)
+        return (np.array([float(sums[0]) / total]),
+                np.array([float(sums[1]) / total]))
 
     # ------------------------------------------------------------ teardown
 

@@ -45,15 +45,17 @@ class DeviceStore:
         (a ``FedDataset.arrays``); uploaded verbatim (uint8 stays uint8).
     iid_shuffle : optional global permutation (``FedDataset.iid_shuffle``) —
         applied on device so host round indices stay the sampler's.
-    augment : "cifar_train" (pad+crop+flip+normalize), "normalize", or None.
+    augment : "cifar_train" (reflect-pad-4 crop + flip + normalize),
+        "emnist_train" (edge-pad-2 crop + normalize), "normalize", or
+        None. Crop parameters are fixed per kind (``_SHIFT_CROP``),
+        mirroring the host stacks in data/transforms.py.
     mean, std : per-channel normalization constants (for the image leaf).
-    pad : crop padding (cifar10_fast uses 4).
     """
 
     def __init__(self, arrays: Dict[str, np.ndarray],
                  iid_shuffle: Optional[np.ndarray] = None,
                  augment: Optional[str] = None,
-                 mean=None, std=None, pad: int = 4,
+                 mean=None, std=None,
                  mesh=None, shard_axis: Optional[str] = None,
                  out_shardings=None):
         if mesh is not None:
@@ -84,7 +86,6 @@ class DeviceStore:
         self.mean = (jnp.asarray(mean, jnp.float32)
                      if mean is not None else None)
         self.std = jnp.asarray(std, jnp.float32) if std is not None else None
-        self.pad = pad
         if out_shardings is not None:
             # explicit per-leaf layout (e.g. the runtime's seq-sharded
             # batch shardings) — must match what the round jit expects
@@ -102,18 +103,23 @@ class DeviceStore:
 
     # ------------------------------------------------------------- internals
 
+    # augment kind -> (crop pad, jnp.pad mode, horizontal flip); mirrors
+    # the host stacks in data/transforms.py (CifarTrain / FemnistTrain)
+    _SHIFT_CROP = {"cifar_train": (4, "reflect", True),
+                   "emnist_train": (2, "edge", False)}
+
     def _transform_images(self, img: jax.Array, rng) -> jax.Array:
         x = img.astype(jnp.float32)
         if img.dtype == jnp.uint8:   # raw 0..255 bytes
             x = x / 255.0
-        if self.augment == "cifar_train":
+        if self.augment in self._SHIFT_CROP:
+            p, pad_mode, flip = self._SHIFT_CROP[self.augment]
             H, W, C = x.shape[-3:]
             flat = x.reshape((-1, H, W, C))
             n = flat.shape[0]
             k1, k2 = jax.random.split(rng)
-            p = self.pad
             padded = jnp.pad(flat, ((0, 0), (p, p), (p, p), (0, 0)),
-                             mode="reflect")  # matches transforms.py
+                             mode=pad_mode)
             offs = jax.random.randint(k1, (n, 2), 0, 2 * p + 1)
 
             def crop_one(im, off):
@@ -121,9 +127,10 @@ class DeviceStore:
                     im, (off[0], off[1], 0), (H, W, C))
 
             flat = jax.vmap(crop_one)(padded, offs)
-            do_flip = jax.random.bernoulli(k2, 0.5, (n,))
-            flat = jnp.where(do_flip[:, None, None, None],
-                             flat[:, :, ::-1, :], flat)
+            if flip:
+                do_flip = jax.random.bernoulli(k2, 0.5, (n,))
+                flat = jnp.where(do_flip[:, None, None, None],
+                                 flat[:, :, ::-1, :], flat)
             x = flat.reshape(x.shape)
         if self.mean is not None:
             x = (x - self.mean) / self.std
@@ -151,12 +158,13 @@ class DeviceStore:
 
 _AUGMENT_FOR = {
     # dataset_name -> (train_augment, normalize-constant prefix)
-    # "host": the train augmentation (e.g. FEMNIST crop/rotate) has no
-    # device equivalent yet — train stays on the host pipeline while eval
-    # still benefits from the device path
+    # "host": the train augmentation has no device equivalent yet (the
+    # ImageNet 224 RandomResizedCrop needs per-image resampling) — train
+    # stays on the host pipeline while eval still benefits from the
+    # device path
     "CIFAR10": ("cifar_train", "CIFAR10"),
     "CIFAR100": ("cifar_train", "CIFAR100"),
-    "EMNIST": ("host", "FEMNIST"),
+    "EMNIST": ("emnist_train", "FEMNIST"),
     "ImageNet": ("host", "IMAGENET"),
     "PERSONA": (None, None),
 }

@@ -59,8 +59,8 @@ def test_factory_gating(tmp_path):
 
     ds = FedCIFAR10(str(tmp_path), train=True, synthetic=True)
     assert make_device_store(ds, "CIFAR10", train=True) is not None
-    # EMNIST train augmentation has no device equivalent => host fallback
-    assert make_device_store(ds, "EMNIST", train=True) is None
+    # ImageNet train augmentation has no device equivalent => host fallback
+    assert make_device_store(ds, "ImageNet", train=True) is None
     # unknown dataset => host fallback
     assert make_device_store(ds, "NOPE", train=True) is None
     # too big => host fallback
@@ -131,3 +131,31 @@ def test_mesh_train_loop_uses_store(tmp_path):
                     num_clients=ds.num_clients, mesh=mesh)
     state, summary = train(cfg, rt, rt.init_state(), ds, ds)
     assert summary is not None and np.isfinite(summary["train_loss"])
+
+
+def test_emnist_train_augment_on_device():
+    """FEMNIST train path no longer falls back to the host pipeline: the
+    edge-pad-2 shift crop (no flip) runs on device, eval path equals the
+    host normalize."""
+    rng = np.random.RandomState(2)
+    arrays = {"image": rng.randint(0, 255, (30, 28, 28, 1), dtype=np.uint8),
+              "target": rng.randint(0, 62, 30).astype(np.int64)}
+
+    class FakeDs:
+        def __init__(self):
+            self.arrays = arrays
+            self.do_iid = False
+
+    store = make_device_store(FakeDs(), "EMNIST", train=True)
+    assert store is not None                 # was a host fallback before
+    out = store.round_batch(np.arange(8), jax.random.PRNGKey(0))
+    assert out["image"].shape == (8, 28, 28, 1)
+    assert out["image"].dtype == jnp.float32
+    # crops differ across keys; values stay in the normalized range
+    out2 = store.round_batch(np.arange(8), jax.random.PRNGKey(1))
+    assert float(jnp.abs(out["image"] - out2["image"]).max()) > 0
+    # eval store still equals the host normalize exactly
+    ev = make_device_store(FakeDs(), "EMNIST", train=False)
+    got = np.asarray(ev.round_batch(np.array([0, 3]), None)["image"])
+    host = T.FemnistEval()({k: v[[0, 3]] for k, v in arrays.items()})
+    np.testing.assert_allclose(got, host["image"], rtol=1e-5, atol=1e-6)
