@@ -7,8 +7,8 @@ throughput).
 
 Round shape: W=8 clients x B=8 dialogues x C=2 candidates x S=256 tokens
 = 32,768 tokens/round (VERDICT r1: the old 2,048-token round amortized the
-124M-d sketch over almost nothing), microbatched 2 dialogues at a time
-with rematerialized blocks, bf16 compute.
+124M-d sketch over almost nothing), microbatched 4 dialogues at a time
+(8 OOMs on a 16 GB chip) with rematerialized blocks, bf16 compute.
 
 MFU is model-FLOPs utilization computed from XLA's own cost analysis of
 the compiled round (so it counts exactly what runs, including the sketch
@@ -100,7 +100,7 @@ def run() -> dict:
 
     cfg = FedConfig(mode="sketch", error_type="virtual", local_momentum=0.0,
                     virtual_momentum=0.9, weight_decay=0.0,
-                    num_workers=W, local_batch_size=B, microbatch_size=2,
+                    num_workers=W, local_batch_size=B, microbatch_size=4,
                     k=50_000, num_rows=5, num_cols=500_000, num_blocks=20,
                     num_clients=100, track_bytes=False, approx_topk=True,
                     num_results_train=2)

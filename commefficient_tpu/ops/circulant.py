@@ -51,6 +51,7 @@ as the hash impl (validated at r·c << d in tests/test_learning.py).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -138,9 +139,29 @@ class CirculantSketch:
         k = jnp.arange(self.c, dtype=jnp.int32)[None, :]
         return (k - sign * s) % self.c
 
+    def _use_pallas(self) -> bool:
+        """OPT-IN fused pallas kernels (ops/circulant_pallas.py,
+        ``COMMEFFICIENT_PALLAS=1``): TPU backend only, and requires a
+        lane-aligned column count (c % 128 == 0 — Mosaic cannot tile an
+        unaligned minor dim, and the reference's default c=500,000 =
+        2^5*5^6 can never align; pick e.g. --num_cols 524288). Validated
+        exact vs the roll path on TPU at small scale; at d=124M the Mosaic
+        compile was observed not to terminate on the remote-compile path,
+        hence opt-in until that is pinned down. The jnp roll path is the
+        default everywhere."""
+        if (self.m <= 1 or self.c % 128
+                or os.environ.get("COMMEFFICIENT_PALLAS") != "1"):
+            return False
+        return jax.default_backend() == "tpu"
+
     def encode(self, vec: jax.Array) -> jax.Array:
         assert vec.ndim == 1 and vec.shape[0] == self.d, (vec.shape, self.d)
         m, c = self.m, self.c
+        if self._use_pallas():
+            from commefficient_tpu.ops.circulant_pallas import pallas_encode
+            vp = jnp.pad(vec.astype(jnp.float32), (0, m * c - self.d))
+            return pallas_encode(vp, jnp.asarray(self.shifts, jnp.int32),
+                                 self.sign_keys, c=c, r=self.r, m=m)
         vp = jnp.pad(vec.astype(jnp.float32), (0, m * c - self.d)).reshape(
             m, c)
         rows = []
@@ -177,6 +198,11 @@ class CirculantSketch:
         assert table.shape == self.table_shape, (table.shape,
                                                  self.table_shape)
         m, c = self.m, self.c
+        if self._use_pallas():
+            from commefficient_tpu.ops.circulant_pallas import pallas_decode
+            return pallas_decode(table, jnp.asarray(self.shifts, jnp.int32),
+                                 self.sign_keys, c=c, r=self.r,
+                                 m=m)[: self.d]
         # chunk the m axis so peak memory is O(r * m/num_blocks * c) on
         # both implementations of the per-block shift
         chunk = max(1, -(-m // max(1, self.num_blocks)))

@@ -276,3 +276,26 @@ class TestCirculantSketch:
         np.testing.assert_allclose(np.asarray(dec_roll),
                                    np.asarray(cs.decode(t_gather)),
                                    atol=1e-5)
+
+    def test_pallas_kernels_match_roll_path(self, monkeypatch):
+        """The opt-in fused pallas kernels (ops/circulant_pallas.py) must
+        reproduce the roll path exactly — validated here in interpret mode
+        (CPU); the TPU path is gated behind COMMEFFICIENT_PALLAS=1."""
+        from commefficient_tpu.ops import circulant as circ
+        from commefficient_tpu.ops.circulant_pallas import (pallas_decode,
+                                                            pallas_encode)
+        cs = circ.make_circulant_sketch(d=5000, c=512, r=5, num_blocks=3,
+                                        seed=7)
+        rng = np.random.RandomState(0)
+        v = jnp.asarray(rng.randn(5000).astype(np.float32))
+        t_roll = cs.encode(v)
+        vp = jnp.pad(v, (0, cs.m * cs.c - cs.d))
+        shifts = jnp.asarray(cs.shifts, jnp.int32)
+        t_pl = pallas_encode(vp, shifts, cs.sign_keys, c=cs.c, r=cs.r,
+                             m=cs.m, interpret=True)
+        np.testing.assert_allclose(np.asarray(t_pl), np.asarray(t_roll),
+                                   atol=1e-4)
+        d_pl = pallas_decode(t_roll, shifts, cs.sign_keys, c=cs.c, r=cs.r,
+                             m=cs.m, interpret=True)[: cs.d]
+        np.testing.assert_allclose(np.asarray(d_pl),
+                                   np.asarray(cs.decode(t_roll)), atol=1e-5)
