@@ -56,6 +56,9 @@ class FedConfig:
     do_finetune: bool = False
     do_checkpoint: bool = False
     checkpoint_path: str = "./checkpoint"
+    # per-shard streaming checkpoint writes (peak host memory = one shard);
+    # required when the state exceeds checkpoint.DEFAULT_MAX_HOST_BYTES
+    checkpoint_sharded: bool = False
     # TPU-native improvement over the reference (which can only save final
     # weights, cv_train.py:418-421): periodic full-FedState checkpoints and
     # exact mid-run resume (see checkpoint.py)
@@ -70,6 +73,12 @@ class FedConfig:
     # images per class for the synthetic CIFAR fallback (no-network runs);
     # the real pickles/tree take precedence when present
     synthetic_per_class: int = 64
+    # non-saturating synthetic regime for time-to-accuracy studies
+    # (data/fed_cifar.py _synthetic_cifar hard=True): shared-base
+    # prototypes + heavy pixel noise (+ train-only label noise) so a
+    # 24-epoch accuracy curve stays well below 100% and keeps climbing
+    synthetic_hard: bool = False
+    synthetic_label_noise: float = 0.0
     num_results_train: int = 2
     num_results_val: int = 2
 
@@ -144,6 +153,11 @@ class FedConfig:
     #   a contraction). Safe only near the lossless regime r*c >= d; the
     #   runtime warns otherwise.
     sketch_impl: str = "circ"
+    # opt-in override for the rht compressing-regime hard error (see
+    # core/server.py validate_mode_combo): rht at r*c < d measurably
+    # diverges under error feedback; this flag exists to reproduce that
+    # study, not to train with
+    allow_divergent_rht: bool = False
     # rht transform compute dtype ("float32" | "bfloat16"); bf16 halves the
     # transform's HBM traffic at ~1e-3 relative estimate noise
     sketch_dtype: str = "float32"
@@ -164,6 +178,11 @@ class FedConfig:
     compilation_cache_dir: str = "~/.cache/commefficient_tpu_xla"
     # rematerialize transformer blocks on backward (memory/FLOPs trade)
     do_remat: bool = False
+    # chunked LM cross-entropy: compute vocab logits ``lm_chunk`` tokens at
+    # a time under jax.checkpoint instead of materializing the full
+    # (tokens, vocab) fp32 tensor (+ cotangent) — the GPT-2 microbatch-8
+    # memory enabler (losses._chunked_lm_nll). 0 = dense
+    lm_chunk: int = 0
 
     # filled in at model-build time, like the reference's args.grad_size
     # (fed_aggregator.py:88). Frozen dataclass => use `replace`.
@@ -255,6 +274,7 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--finetune", action="store_true", dest="do_finetune")
     p.add_argument("--checkpoint", action="store_true", dest="do_checkpoint")
     p.add_argument("--checkpoint_path", type=str, default="./checkpoint")
+    p.add_argument("--checkpoint_sharded", action="store_true")
     p.add_argument("--checkpoint_every", type=int, default=0)
     p.add_argument("--resume", action="store_true", dest="do_resume")
     p.add_argument("--resume_unverified", action="store_true")
@@ -267,6 +287,8 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--dataset_dir", type=str, default="./dataset")
     p.add_argument("--batchnorm", action="store_true", dest="do_batchnorm")
     p.add_argument("--synthetic_per_class", type=int, default=64)
+    p.add_argument("--synthetic_hard", action="store_true")
+    p.add_argument("--synthetic_label_noise", type=float, default=0.0)
 
     p.add_argument("--k", type=int, default=50_000)
     p.add_argument("--num_cols", type=int, default=500_000)
@@ -317,6 +339,7 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--param_dtype", type=str, default="float32")
     p.add_argument("--max_client_batch", type=int, default=512)
     p.add_argument("--sketch_seed", type=int, default=42)
+    p.add_argument("--allow_divergent_rht", action="store_true")
     p.add_argument("--sketch_impl", choices=("circ", "hash", "rht"),
                    default="circ")
     p.add_argument("--sketch_dtype", choices=("float32", "bfloat16"),
@@ -329,6 +352,7 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                    default="~/.cache/commefficient_tpu_xla",
                    help="persistent XLA compile cache; empty disables")
     p.add_argument("--remat", action="store_true", dest="do_remat")
+    p.add_argument("--lm_chunk", type=int, default=0)
     return parser
 
 

@@ -184,15 +184,20 @@ def main(argv=None):
                 f"max_seq_len ({max_seq_len})")
         train_model = GPT2DoubleHeads(gcfg, seq_axis="seq",
                                       seq_shards=seq_shards)
+        # lm_chunk is passed so the unsupported lm_chunk+seq combination
+        # FAILS FAST in the loss builder instead of silently running dense
         loss_train = make_gpt2_train_loss(train_model, cfg.lm_coef,
                                           cfg.mc_coef, seq_axis="seq",
-                                          seq_shards=seq_shards)
+                                          seq_shards=seq_shards,
+                                          lm_chunk=cfg.lm_chunk)
         print(f"sequence parallelism: ring attention over {seq_shards} "
               "shards")
     else:
-        loss_train = make_gpt2_train_loss(model, cfg.lm_coef, cfg.mc_coef)
-    # validation always runs the dense model (same param pytree)
-    loss_val = make_gpt2_val_loss(model)
+        loss_train = make_gpt2_train_loss(model, cfg.lm_coef, cfg.mc_coef,
+                                          lm_chunk=cfg.lm_chunk)
+    # validation always runs the dense model (same param pytree); on a
+    # mesh the val batch shards over all devices (runtime._val_step_sharded)
+    loss_val = make_gpt2_val_loss(model, lm_chunk=cfg.lm_chunk)
     runtime = FedRuntime(cfg, params, loss_train, loss_val,
                          num_clients=train_ds.num_clients,
                          mesh=mesh,

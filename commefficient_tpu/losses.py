@@ -23,7 +23,45 @@ def _cast(tree, dtype):
         else t, tree)
 
 
-def _gpt2_losses(model, params, batch, mask, seq_axis=None, seq_shards=1):
+def _chunked_lm_nll(hidden, wte, labels, m, chunk):
+    """Shifted LM cross-entropy without ever materializing the full
+    (tokens, vocab) logits: scan the sequence in ``chunk``-token slices,
+    projecting + log-softmaxing each slice and accumulating the masked
+    NLL sums. ``jax.checkpoint`` on the scan body makes the backward pass
+    recompute each slice's logits instead of saving them, so peak memory
+    is O(chunk·V) — the enabler for microbatch ≥ 8 at the 32k-token GPT-2
+    round (the full fp32 logits + cotangent were ~1.6 GB per microbatch
+    step). fp32 accumulation; bitwise-equivalent math to the dense path
+    up to sum reordering (asserted by tests/test_models.py)."""
+    h = hidden[..., :-1, :]                           # (B, C, S-1, E)
+    lab = labels[..., 1:]                             # (B, C, S-1)
+    B, C, T, E = h.shape
+    pad = (-T) % chunk
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        lab = jnp.pad(lab, ((0, 0), (0, 0), (0, pad)), constant_values=-100)
+    nch = (T + pad) // chunk
+    h = h.reshape(B, C, nch, chunk, E).transpose(2, 0, 1, 3, 4)
+    lab = lab.reshape(B, C, nch, chunk).transpose(2, 0, 1, 3)
+
+    def body(carry, inp):
+        num, den = carry
+        hc, lc = inp                                  # (B, C, chunk, ...)
+        tok_valid = ((lc != -100) * m[:, None, None]).astype(jnp.float32)
+        logits = (hc @ wte.T.astype(hc.dtype)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(
+            logp, jnp.maximum(lc, 0)[..., None], axis=-1)[..., 0]
+        return (num + (nll * tok_valid).sum(),
+                den + tok_valid.sum()), None
+
+    (num, den), _ = lax.scan(jax.checkpoint(body),
+                             (jnp.zeros(()), jnp.zeros(())), (h, lab))
+    return num / jnp.maximum(den, 1.0)
+
+
+def _gpt2_losses(model, params, batch, mask, seq_axis=None, seq_shards=1,
+                 lm_chunk: int = 0):
     """Shared DoubleHeads forward: (lm_nll_per_token, mc_loss, mc_acc).
 
     ``seq_axis``: set when the model runs seq-sharded inside a shard_map
@@ -31,11 +69,29 @@ def _gpt2_losses(model, params, batch, mask, seq_axis=None, seq_shards=1):
     boundaries — each shard fetches its right neighbour's first label
     column via ``ppermute`` — and the masked token means psum over the
     axis, so every shard computes the identical GLOBAL loss (its gradient
-    contribution stays local to its tokens; the runtime sums shards)."""
+    contribution stays local to its tokens; the runtime sums shards).
+
+    ``lm_chunk`` > 0 (dense path only): compute the LM loss via
+    _chunked_lm_nll instead of full-vocab logits."""
+    if lm_chunk > 0 and seq_axis is not None:
+        # fail fast: silently falling back to full-vocab logits would OOM
+        # exactly the runs that asked for the memory-bounded path
+        raise ValueError(
+            "lm_chunk is not supported together with a seq mesh axis yet "
+            "(the seq branch computes its own cross-shard label shift on "
+            "full logits); drop --lm_chunk or the seq axis")
+    m = mask.astype(jnp.float32)                      # (B,)
+    if lm_chunk > 0 and seq_axis is None:
+        hidden, wte, mc_logits = model.apply(
+            params, batch["input_ids"], batch["mc_token_ids"],
+            batch["token_type_ids"], method="hidden_and_mc")
+        lm_loss = _chunked_lm_nll(hidden, wte, batch["lm_labels"], m,
+                                  lm_chunk)
+        return (lm_loss,) + _mc_metrics(mc_logits, batch, m)
+
     lm_logits, mc_logits = model.apply(
         params, batch["input_ids"], batch["mc_token_ids"],
         batch["token_type_ids"])
-    m = mask.astype(jnp.float32)                      # (B,)
 
     if seq_axis is None:
         sh_logits = lm_logits[..., :-1, :]            # (B, C, S-1, V)
@@ -62,7 +118,10 @@ def _gpt2_losses(model, params, batch, mask, seq_axis=None, seq_shards=1):
         num = lax.psum(num, seq_axis)
         den = lax.psum(den, seq_axis)
     lm_loss = num / jnp.maximum(den, 1.0)
+    return (lm_loss,) + _mc_metrics(mc_logits, batch, m)
 
+
+def _mc_metrics(mc_logits, batch, m):
     mc_logp = jax.nn.log_softmax(mc_logits, axis=-1)  # (B, C)
     mc_nll = -jnp.take_along_axis(
         mc_logp, batch["mc_label"][:, None], axis=-1)[:, 0]
@@ -70,35 +129,38 @@ def _gpt2_losses(model, params, batch, mask, seq_axis=None, seq_shards=1):
     mc_loss = (mc_nll * m).sum() / denom
     acc = (((jnp.argmax(mc_logits, -1) == batch["mc_label"]) * m).sum()
            / denom)
-    return lm_loss, mc_loss, acc
+    return mc_loss, acc
 
 
 def make_gpt2_train_loss(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
-                         seq_axis=None, seq_shards: int = 1):
+                         seq_axis=None, seq_shards: int = 1,
+                         lm_chunk: int = 0):
     """DoubleHeads training loss (reference gpt2_train.py:88-99):
     ``lm_coef * lm_loss + mc_coef * mc_loss`` where the LM loss is shifted
     cross-entropy over the gold candidate's reply tokens and the MC loss is
     cross-entropy over candidates. Metrics: (mc accuracy,). Pass
     ``seq_axis``/``seq_shards`` matching the model's when it runs
-    seq-sharded."""
+    seq-sharded; ``lm_chunk`` > 0 enables the memory-bounded chunked LM
+    cross-entropy (dense path)."""
 
     def loss_fn(params, batch, mask):
         lm_loss, mc_loss, acc = _gpt2_losses(
             model, params, batch, mask, seq_axis=seq_axis,
-            seq_shards=seq_shards)
+            seq_shards=seq_shards, lm_chunk=lm_chunk)
         return lm_coef * lm_loss + mc_coef * mc_loss, (acc,)
 
     return loss_fn
 
 
-def make_gpt2_val_loss(model, seq_axis=None, seq_shards: int = 1):
+def make_gpt2_val_loss(model, seq_axis=None, seq_shards: int = 1,
+                       lm_chunk: int = 0):
     """Validation metrics (reference test_gpt2, gpt2_train.py:55-86):
     per-token LM NLL (=> ppl on the host) and MC accuracy."""
 
     def loss_fn(params, batch, mask):
         lm_loss, _, acc = _gpt2_losses(
             model, params, batch, mask, seq_axis=seq_axis,
-            seq_shards=seq_shards)
+            seq_shards=seq_shards, lm_chunk=lm_chunk)
         return lm_loss, (acc,)
 
     return loss_fn

@@ -181,14 +181,25 @@ class GPT2DoubleHeads(nn.Module):
     seq_axis: Optional[str] = None
     seq_shards: int = 1
 
-    @nn.compact
     def __call__(self, input_ids, mc_token_ids, token_type_ids=None):
+        hidden, wte, mc_logits = self.hidden_and_mc(input_ids, mc_token_ids,
+                                                    token_type_ids)
+        lm_logits = (hidden @ wte.T.astype(hidden.dtype)).astype(jnp.float32)
+        return lm_logits, mc_logits
+
+    @nn.compact
+    def hidden_and_mc(self, input_ids, mc_token_ids, token_type_ids=None):
+        """Backbone output WITHOUT the (tokens, vocab) LM projection:
+        (hidden, wte, mc_logits). The chunked-CE loss path
+        (losses._chunked_lm_nll) projects and softmaxes vocab logits
+        chunk-by-chunk instead — at microbatch 8 the full fp32 logits
+        tensor alone is ~0.8 GB and (with its cotangent) is what capped
+        the GPT-2 round's microbatch size."""
         hidden, wte = GPT2Backbone(self.cfg, self.attn_impl,
                                    seq_axis=self.seq_axis,
                                    seq_shards=self.seq_shards,
                                    name="transformer")(
             input_ids, token_type_ids)
-        lm_logits = (hidden @ wte.T.astype(hidden.dtype)).astype(jnp.float32)
         # mc_head is bias-free: a bias on a 1-unit head shifts every
         # candidate's logit equally, which the MC softmax is invariant to —
         # and bias-freeness lets the seq-sharded branch psum LOGIT
@@ -211,7 +222,7 @@ class GPT2DoubleHeads(nn.Module):
             mc_hidden = jnp.take_along_axis(
                 hidden, mc_token_ids[..., None, None], axis=-2)[..., 0, :]
             mc_logits = mc_head(mc_hidden)[..., 0]
-        return lm_logits, mc_logits
+        return hidden, wte, mc_logits
 
 
 class GPT2LMHead(nn.Module):

@@ -139,25 +139,43 @@ class CirculantSketch:
         k = jnp.arange(self.c, dtype=jnp.int32)[None, :]
         return (k - sign * s) % self.c
 
-    def _use_pallas(self) -> bool:
-        """OPT-IN fused pallas kernels (ops/circulant_pallas.py,
-        ``COMMEFFICIENT_PALLAS=1``): TPU backend only, and requires a
-        lane-aligned column count (c % 128 == 0 — Mosaic cannot tile an
-        unaligned minor dim, and the reference's default c=500,000 =
-        2^5*5^6 can never align; pick e.g. --num_cols 524288). Validated
-        exact vs the roll path on TPU at small scale; at d=124M the Mosaic
-        compile was observed not to terminate on the remote-compile path,
-        hence opt-in until that is pinned down. The jnp roll path is the
-        default everywhere."""
-        if (self.m <= 1 or self.c % 128
-                or os.environ.get("COMMEFFICIENT_PALLAS") != "1"):
+    def _pallas_eligible(self) -> bool:
+        """Fused pallas kernels (ops/circulant_pallas.py) need: TPU
+        backend, a SHIFT_ALIGN-granular column count AND shift table
+        (``make_circulant_sketch`` generates aligned shifts whenever
+        c % 1024 == 0 — the reference's default c=500,000 = 2^5·5^6 can
+        never align; pick e.g. --num_cols 524288), and the wrap-padded
+        table within the decode kernel's VMEM residency budget.
+        ``COMMEFFICIENT_PALLAS=0`` disables outright."""
+        if (self.m <= 1 or os.environ.get("COMMEFFICIENT_PALLAS") == "0"
+                or jax.default_backend() != "tpu"):
             return False
-        return jax.default_backend() == "tpu"
+        from commefficient_tpu.ops.circulant_pallas import (
+            SHIFT_ALIGN, TABLE_VMEM_BUDGET, _lane_tile)
+        if self.c % SHIFT_ALIGN:
+            return False
+        if any(s % SHIFT_ALIGN for row in self.shifts for s in row):
+            return False
+        return 4 * self.r * (self.c + _lane_tile(self.c)) \
+            <= TABLE_VMEM_BUDGET
+
+    def _use_pallas_decode(self) -> bool:
+        # default ON when eligible: measured 21 ms vs the roll path's
+        # 129 ms at the flagship d=124M config
+        return self._pallas_eligible()
+
+    def _use_pallas_encode(self) -> bool:
+        # the static-roll XLA encode is already ~26 ms (the shifts are
+        # trace-time constants, compiled to fixed slices); the pallas
+        # encode re-reads the input nct times and lands ~equal, so it
+        # stays opt-in
+        return (os.environ.get("COMMEFFICIENT_PALLAS") == "1"
+                and self._pallas_eligible())
 
     def encode(self, vec: jax.Array) -> jax.Array:
         assert vec.ndim == 1 and vec.shape[0] == self.d, (vec.shape, self.d)
         m, c = self.m, self.c
-        if self._use_pallas():
+        if self._use_pallas_encode():
             from commefficient_tpu.ops.circulant_pallas import pallas_encode
             vp = jnp.pad(vec.astype(jnp.float32), (0, m * c - self.d))
             return pallas_encode(vp, jnp.asarray(self.shifts, jnp.int32),
@@ -198,7 +216,7 @@ class CirculantSketch:
         assert table.shape == self.table_shape, (table.shape,
                                                  self.table_shape)
         m, c = self.m, self.c
-        if self._use_pallas():
+        if self._use_pallas_decode():
             from commefficient_tpu.ops.circulant_pallas import pallas_decode
             return pallas_decode(table, jnp.asarray(self.shifts, jnp.int32),
                                  self.sign_keys, c=c, r=self.r,
@@ -242,10 +260,29 @@ class CirculantSketch:
 
 def make_circulant_sketch(d: int, c: int, r: int, num_blocks: int = 1,
                           seed: int = 42) -> CirculantSketch:
+    """Shift granularity: when c % 1024 == 0, shifts are drawn as uniform
+    MULTIPLES of 1024 (= 8 sublanes x 128 lanes). That makes every span
+    of a per-block roll start on a TPU vreg boundary, which is what lets
+    the pallas decode kernel extract it with one sublane-dynamic slice
+    instead of a dynamic rotate (ops/circulant_pallas.py v4 — measured
+    6x). Statistics are unchanged in the quantities that matter: two
+    coordinates i (block b), i' (block b') collide iff
+    s_b − s_b' ≡ i' − i (mod c), which under 1024-granular shifts has
+    probability 1024/c when i ≡ i' (mod 1024) and 0 otherwise — the
+    bucket map partitions coordinates into residue classes, colliding
+    1024x more often within a class and never across, so the per-row
+    estimate variance stays ≤ ||v||²/c in expectation and rows remain
+    independent: the CountSketch median guarantee is untouched. (Same-
+    block coordinates still never collide.)"""
     rng = np.random.RandomState(seed)
     m = -(-d // c)
-    shifts = tuple(tuple(int(s) for s in rng.randint(0, c, size=m))
-                   for _ in range(r))
+    if c % 1024 == 0:
+        shifts = tuple(
+            tuple(int(s) * 1024 for s in rng.randint(0, c // 1024, size=m))
+            for _ in range(r))
+    else:
+        shifts = tuple(tuple(int(s) for s in rng.randint(0, c, size=m))
+                       for _ in range(r))
     sign_keys = rng.randint(0, 2**32, size=(r,),
                             dtype=np.uint64).astype(np.uint32) | 1
     return CirculantSketch(jnp.asarray(sign_keys), shifts, d=d, c=c, r=r,

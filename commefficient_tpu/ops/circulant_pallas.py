@@ -1,21 +1,41 @@
 """Pallas TPU kernels for the circulant count sketch's encode/decode.
 
 The jnp implementation in ops/circulant.py compiles the per-(row, block)
-static rolls into r·m separate slice+concat HLO ops (1,250 at the GPT-2
-config: m=250 blocks, r=5 rows) — measured ~70 us of fixed overhead per
-op, i.e. ~87/103 ms per encode/decode at d=124M even though only ~7.5 GB
-of HBM traffic is involved. These kernels fuse each direction into ONE
-``pallas_call`` with a grid over 8-block superblocks: block DMAs
-pipeline, the rotation is Mosaic's dynamic-shift ``pltpu.roll``, signs
-come from the same murmur mixer computed in-kernel, and the (r, c)
-accumulator (encode) / median network (decode) stay resident in VMEM.
+static rolls into r·m separate slice+concat HLO ops (1,185 at the GPT-2
+config: m=237 blocks, r=5 rows), each paying XLA's fixed per-op cost —
+measured (chained on-device, d=124M, c=524288, v5e) ~26 ms encode and
+~129 ms decode. These kernels fuse each direction into ONE
+``pallas_call``.
 
-STATUS: OPT-IN (``COMMEFFICIENT_PALLAS=1`` + TPU backend + c % 128 == 0;
-see CirculantSketch._use_pallas). Semantics are identical to the roll
-path — asserted in interpret mode by tests/test_ops.py and verified
-against the TPU at small scale — but at d=124M the Mosaic compile was
-observed not to terminate on the remote-compile path, so the roll path
-remains the default.
+Design history (all numbers measured the same way):
+- v1 DMA'd whole (8, c) row-groups: 16 MB blocks double-buffered against
+  ~16 MB VMEM — the Mosaic compile never terminated.
+- v2 lane-tiled with two-tile gathers + dynamic ``pltpu.roll``:
+  68/94 ms — DMA-descriptor-bound (19k small DMAs × ~5 us latency).
+- v3 streamed big blocks / kept the table resident: 67/110 ms — the
+  residual cost is the DYNAMIC ``pltpu.roll`` itself (Mosaic lowers a
+  dynamic lane rotate as a multi-stage shift network; a 10-roll/step
+  ablation costs +100 ms over the 23 ms copy floor).
+- v4 (this file) eliminates rotates entirely: shifts are restricted to
+  multiples of 1024 = 8 sublanes × 128 lanes (``make_circulant_sketch``
+  applies that granularity whenever c % 1024 == 0 — see the statistics
+  note there), so every span of a conceptual roll starts on a vreg
+  boundary and comes out of a VMEM-resident, wrap-padded
+  (rows, c/128 (+span), 128) view with ONE sublane-dynamic slice — pure
+  address arithmetic, no data movement beyond the copy itself.
+  Measured: decode 21 ms (6× over the roll path), with the whole table
+  loaded into VMEM once (constant index map).
+
+Exactness vs the roll path is asserted in interpret mode by
+tests/test_ops.py and against numpy on the TPU at flagship scale.
+Used AUTOMATICALLY for decode on TPU when the sketch's shifts are
+1024-aligned and the wrap-padded table fits the VMEM residency budget;
+encode keeps the static-roll XLA path by default (26 ms — the rolls are
+trace-time constants there, which XLA compiles to fixed slices; the
+pallas encode re-reads the input nct times and lands at ~the same
+cost). ``COMMEFFICIENT_PALLAS=0`` disables, ``=1`` also forces the
+pallas encode. Replaces the external CUDA CSVec hot path (reference
+fed_worker.py:312-320).
 """
 
 from __future__ import annotations
@@ -24,6 +44,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -33,98 +54,142 @@ from commefficient_tpu.ops.topk import median_axis0
 _U32 = jnp.uint32
 _GOLDEN = 0x9E3779B9
 
+# shift granularity that makes every span start a whole number of vregs
+# (8 sublanes x 128 lanes) into the row — the no-rotate enabler
+SHIFT_ALIGN = 1024
 
-def _signs_block(b, c, key):
-    """(1, c) ±1 signs of block b under sign key ``key`` — the same stream
-    as CirculantSketch._sign_of."""
-    idx = (b * c + jax.lax.broadcasted_iota(jnp.int32, (1, c), 1)
-           ).astype(_U32)
+# decode keeps the wrap-padded (r, c/128 + ct/128, 128) table resident in
+# VMEM: cap its footprint (bytes) under the ~16 MB/core budget with room
+# for temporaries
+TABLE_VMEM_BUDGET = 12 << 20
+
+# lane-tile width of the streamed output/input spans
+_CT_MAX = 65536
+
+
+def _lane_tile(c: int) -> int:
+    """Largest divisor of c that is a multiple of SHIFT_ALIGN and ≤
+    _CT_MAX. Callers guarantee c % SHIFT_ALIGN == 0, so SHIFT_ALIGN
+    itself is always a valid fallback."""
+    for n in range(1, c // SHIFT_ALIGN + 1):
+        if c % n == 0 and (c // n) % SHIFT_ALIGN == 0 and c // n <= _CT_MAX:
+            return c // n
+    raise ValueError(f"c={c} has no {SHIFT_ALIGN}-aligned lane tile")
+
+
+def _signs2d(start, sub, key):
+    """(sub, 128) ±1 signs for global coordinates [start, start+128·sub)
+    in vreg layout — the same murmur stream as CirculantSketch._sign_of.
+    ``start`` may be a traced scalar."""
+    idx = (start
+           + 128 * lax.broadcasted_iota(jnp.int32, (sub, 128), 0)
+           + lax.broadcasted_iota(jnp.int32, (sub, 128), 1)).astype(_U32)
     h = _mix32(idx * key + _U32(_GOLDEN))
     # Mosaic can't cast uint32 -> f32 directly; the top bit is 0/1 so an
     # int32 hop is exact
     return 1.0 - 2.0 * (h >> 31).astype(jnp.int32).astype(jnp.float32)
 
 
-# TPU lowering requires block second-minor dims divisible by 8 (or equal
-# to the array dim): process 8 coordinate-blocks per grid step
-_SUPER = 8
+def _signs2d_modc(base, q, c, sub, key):
+    """Signs for input coordinates base + ((q + u) mod c), u the flat
+    vreg-layout offset — the encode span crosses the block's mod-c seam
+    at most once, so one conditional subtract realizes the mod."""
+    pos = (q
+           + 128 * lax.broadcasted_iota(jnp.int32, (sub, 128), 0)
+           + lax.broadcasted_iota(jnp.int32, (sub, 128), 1))
+    pos = pos - jnp.where(pos >= c, c, 0)
+    h = _mix32((base + pos).astype(_U32) * key + _U32(_GOLDEN))
+    return 1.0 - 2.0 * (h >> 31).astype(jnp.int32).astype(jnp.float32)
 
 
-def _encode_kernel(shifts_ref, keys_ref, v_ref, out_ref, *, c, r):
-    g = pl.program_id(0)
+def _decode_kernel(shifts_ref, keys_ref, t_ref, out_ref, *, c, r, ct):
+    b, t = pl.program_id(0), pl.program_id(1)
+    sub = ct // 128
+    ests = []
+    for j in range(r):
+        # est[i] = sign(b·c+i) · table[j, (i + s) mod c]: the span starts
+        # q = (t·ct + s) mod c into the row; with s 1024-aligned, q//128
+        # is a whole vreg offset and the wrap padding makes the slice
+        # contiguous — no rotate
+        q = (t * ct + shifts_ref[j, b]) % c
+        span = t_ref[j, pl.ds(q // 128, sub)]            # (sub, 128)
+        ests.append(_signs2d(b * c + t * ct, sub, keys_ref[j]) * span)
+    out_ref[0, 0] = median_axis0(jnp.stack(ests, axis=0))
 
-    @pl.when(g == 0)
+
+def _encode_kernel(shifts_ref, keys_ref, v_ref, out_ref, *, c, r, ct):
+    t, b = pl.program_id(0), pl.program_id(1)
+    sub = ct // 128
+
+    @pl.when(b == 0)
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    for jj in range(_SUPER):
-        b = g * _SUPER + jj
-        v = v_ref[jj:jj + 1, :]                          # (1, c)
-        for j in range(r):
-            sv = _signs_block(b, c, keys_ref[j]) * v     # (1, c)
-            # Mosaic's dynamic-shift rotate (jnp.roll semantics)
-            out_ref[j:j + 1, :] += pltpu.roll(sv, shifts_ref[j, b], axis=1)
+    for j in range(r):
+        # table[j, t·ct + u] += sign(input) · v_b[(t·ct + u − s) mod c]
+        q = (t * ct + c - shifts_ref[j, b]) % c
+        span = v_ref[0, pl.ds(q // 128, sub)]            # (sub, 128)
+        out_ref[0, j] += _signs2d_modc(b * c, q, c, sub,
+                                       keys_ref[j]) * span
 
 
-def _decode_kernel(shifts_ref, keys_ref, t_ref, out_ref, *, c, r):
-    g = pl.program_id(0)
-    for jj in range(_SUPER):
-        b = g * _SUPER + jj
-        ests = []
-        for j in range(r):
-            # inverse rotation: roll by (c - s) mod c == roll by -s
-            s = shifts_ref[j, b]
-            rolled = pltpu.roll(t_ref[j:j + 1, :], (c - s) % c, axis=1)
-            ests.append(_signs_block(b, c, keys_ref[j]) * rolled)
-        out_ref[jj:jj + 1, :] = median_axis0(
-            jnp.concatenate(ests, axis=0))[None]
-
-
-def _pad_blocks(m):
-    return -(-m // _SUPER) * _SUPER
+def _wrap_pad(x3, sub):
+    """(..., n, 128) -> (..., n+sub, 128) with the first ``sub``
+    sublane-rows appended, so a mod-n span never wraps."""
+    return jnp.concatenate([x3, x3[..., :sub, :]], axis=-2)
 
 
 @functools.partial(jax.jit, static_argnames=("c", "r", "m", "interpret"))
 def pallas_encode(vec_padded, shifts, sign_keys, *, c, r, m,
                   interpret=False):
-    """(m*c,) padded fp32 vector -> (r, c) table. ``shifts``: (r, m) int32;
-    ``sign_keys``: (r,) uint32."""
-    mp = _pad_blocks(m)
-    blocks = jnp.pad(vec_padded.astype(jnp.float32),
-                     (0, mp * c - m * c)).reshape(mp, c)
-    # padded blocks carry zeros (contribution 0); their shifts just need
-    # to exist and be in range
-    shifts_p = jnp.pad(shifts, ((0, 0), (0, mp - m)))
+    """(m*c,) padded fp32 vector -> (r, c) table. ``shifts``: (r, m) int32
+    multiples of SHIFT_ALIGN; ``sign_keys``: (r,) uint32."""
+    ct = _lane_tile(c)
+    sub, csub, nct = ct // 128, c // 128, c // ct
+    blocks = _wrap_pad(
+        vec_padded.astype(jnp.float32).reshape(m, csub, 128), sub)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(mp // _SUPER,),
-        in_specs=[pl.BlockSpec((_SUPER, c), lambda g, *_: (g, 0))],
-        out_specs=pl.BlockSpec((r, c), lambda g, *_: (0, 0)),
+        # lane-tiles outer, vector blocks inner: each inner step streams
+        # one whole wrap-padded block (ONE DMA) and accumulates all r
+        # rows of the resident (1, r, sub, 128) table tile
+        grid=(nct, m),
+        in_specs=[pl.BlockSpec((1, csub + sub, 128),
+                               lambda t, b, *_: (b, 0, 0))],
+        out_specs=pl.BlockSpec((1, r, sub, 128),
+                               lambda t, b, *_: (t, 0, 0, 0)),
     )
-    return pl.pallas_call(
-        functools.partial(_encode_kernel, c=c, r=r),
-        out_shape=jax.ShapeDtypeStruct((r, c), jnp.float32),
+    out = pl.pallas_call(
+        functools.partial(_encode_kernel, c=c, r=r, ct=ct),
+        out_shape=jax.ShapeDtypeStruct((nct, r, sub, 128), jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(shifts_p, sign_keys, blocks)
+    )(shifts, sign_keys, blocks)
+    # (nct, r, sub, 128) -> (r, c): element (t, j, s, l) is
+    # table[j, t·ct + s·128 + l]
+    return out.transpose(1, 0, 2, 3).reshape(r, c)
 
 
 @functools.partial(jax.jit, static_argnames=("c", "r", "m", "interpret"))
 def pallas_decode(table, shifts, sign_keys, *, c, r, m, interpret=False):
-    """(r, c) table -> (m*c,) padded per-coordinate median estimates
-    (trailing block-padding garbage is sliced off by the caller)."""
-    mp = _pad_blocks(m)
-    shifts_p = jnp.pad(shifts, ((0, 0), (0, mp - m)))
+    """(r, c) table -> (m*c,) per-coordinate median estimates."""
+    ct = _lane_tile(c)
+    sub, csub, nct = ct // 128, c // 128, c // ct
+    t3 = _wrap_pad(table.astype(jnp.float32).reshape(r, csub, 128), sub)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(mp // _SUPER,),
-        in_specs=[pl.BlockSpec((r, c), lambda g, *_: (0, 0))],
-        out_specs=pl.BlockSpec((_SUPER, c), lambda g, *_: (g, 0)),
+        grid=(m, nct),
+        # constant index map: the whole wrap-padded table loads into VMEM
+        # once and stays resident for all m·nct steps
+        in_specs=[pl.BlockSpec((r, csub + sub, 128),
+                               lambda b, t, *_: (0, 0, 0))],
+        out_specs=pl.BlockSpec((1, 1, sub, 128),
+                               lambda b, t, *_: (b, t, 0, 0)),
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, c=c, r=r),
-        out_shape=jax.ShapeDtypeStruct((mp, c), jnp.float32),
+        functools.partial(_decode_kernel, c=c, r=r, ct=ct),
+        out_shape=jax.ShapeDtypeStruct((m, nct, sub, 128), jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(shifts_p, sign_keys, table.astype(jnp.float32))
-    return out.reshape(-1)[: m * c]
+    )(shifts, sign_keys, t3)
+    return out.reshape(-1)

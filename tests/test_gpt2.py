@@ -78,6 +78,36 @@ def test_losses_finite_and_trainable(tiny_model):
     assert np.isfinite(float(nll))
 
 
+def test_chunked_lm_loss_matches_dense(tiny_model):
+    """lm_chunk (the memory-bounded CE that never materializes full-vocab
+    logits — the microbatch-8 enabler) must reproduce the dense loss AND
+    its gradients, including a chunk size that does not divide S-1."""
+    cfg, model, params = tiny_model
+    rng = np.random.RandomState(2)
+    B, C, S = 3, 2, 16
+    batch = {
+        "input_ids": jnp.asarray(rng.randint(0, 256, (B, C, S))),
+        "token_type_ids": jnp.asarray(rng.randint(0, 256, (B, C, S))),
+        "mc_token_ids": jnp.full((B, C), S - 1, jnp.int32),
+        "lm_labels": jnp.asarray(
+            np.where(rng.rand(B, C, S) < 0.5, rng.randint(0, 256, (B, C, S)),
+                     -100)),
+        "mc_label": jnp.asarray(rng.randint(0, C, (B,))),
+    }
+    mask = jnp.asarray([1, 1, 0], jnp.float32)
+    dense_fn = make_gpt2_train_loss(model)
+    (l0, (a0,)), g0 = jax.value_and_grad(dense_fn, has_aux=True)(
+        params, batch, mask)
+    for chunk in (4, 7, 64):  # divides, doesn't divide, > S-1
+        ck_fn = make_gpt2_train_loss(model, lm_chunk=chunk)
+        (l1, (a1,)), g1 = jax.value_and_grad(ck_fn, has_aux=True)(
+            params, batch, mask)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        np.testing.assert_allclose(float(a0), float(a1))
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6), g0, g1)
+
+
 def test_build_input_from_segments():
     tok = HashTokenizer(64)
     persona = [tok.encode("i like cats"), tok.encode("i run")]

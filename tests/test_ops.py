@@ -277,17 +277,28 @@ class TestCirculantSketch:
                                    np.asarray(cs.decode(t_gather)),
                                    atol=1e-5)
 
+    def test_aligned_shift_granularity(self):
+        """c % 1024 == 0 => shifts are multiples of 1024 (the pallas
+        no-rotate enabler); unaligned c keeps full-range shifts."""
+        from commefficient_tpu.ops import circulant as circ
+        cs = circ.make_circulant_sketch(d=9000, c=2048, r=3, seed=5)
+        assert all(s % 1024 == 0 for row in cs.shifts for s in row)
+        assert any(s != 0 for row in cs.shifts for s in row)
+        cs2 = circ.make_circulant_sketch(d=9000, c=500, r=3, seed=5)
+        assert any(s % 1024 for row in cs2.shifts for s in row)
+
     def test_pallas_kernels_match_roll_path(self, monkeypatch):
-        """The opt-in fused pallas kernels (ops/circulant_pallas.py) must
-        reproduce the roll path exactly — validated here in interpret mode
-        (CPU); the TPU path is gated behind COMMEFFICIENT_PALLAS=1."""
+        """The fused pallas kernels (ops/circulant_pallas.py v4,
+        sublane-slice span extraction) must reproduce the roll path
+        exactly — validated here in interpret mode (CPU); the TPU decode
+        path is on by default when eligible."""
         from commefficient_tpu.ops import circulant as circ
         from commefficient_tpu.ops.circulant_pallas import (pallas_decode,
                                                             pallas_encode)
-        cs = circ.make_circulant_sketch(d=5000, c=512, r=5, num_blocks=3,
+        cs = circ.make_circulant_sketch(d=9000, c=2048, r=5, num_blocks=3,
                                         seed=7)
         rng = np.random.RandomState(0)
-        v = jnp.asarray(rng.randn(5000).astype(np.float32))
+        v = jnp.asarray(rng.randn(9000).astype(np.float32))
         t_roll = cs.encode(v)
         vp = jnp.pad(v, (0, cs.m * cs.c - cs.d))
         shifts = jnp.asarray(cs.shifts, jnp.int32)
@@ -297,5 +308,33 @@ class TestCirculantSketch:
                                    atol=1e-4)
         d_pl = pallas_decode(t_roll, shifts, cs.sign_keys, c=cs.c, r=cs.r,
                              m=cs.m, interpret=True)[: cs.d]
+        np.testing.assert_allclose(np.asarray(d_pl),
+                                   np.asarray(cs.decode(t_roll)), atol=1e-5)
+
+    def test_pallas_multi_lane_tile_matches_roll_path(self, monkeypatch):
+        """At real scale (c=524288 > _CT_MAX) the kernels tile the lane
+        dimension; spans then cross lane-tile (and mod-c wrap) boundaries
+        through the wrap padding. Exercise that path by shrinking _CT_MAX
+        so c=2048 splits into 2 tiles of 1024."""
+        from commefficient_tpu.ops import circulant as circ
+        from commefficient_tpu.ops import circulant_pallas as cp
+        monkeypatch.setattr(cp, "_CT_MAX", 1024)
+        assert cp._lane_tile(2048) == 1024
+        # d chosen so m differs from the test above: pallas_encode is
+        # jit-cached on (c, r, m, interpret), and a shape collision would
+        # silently reuse the un-monkeypatched single-tile trace
+        cs = circ.make_circulant_sketch(d=11000, c=2048, r=5, num_blocks=3,
+                                        seed=11)
+        rng = np.random.RandomState(2)
+        v = jnp.asarray(rng.randn(11000).astype(np.float32))
+        t_roll = cs.encode(v)
+        vp = jnp.pad(v, (0, cs.m * cs.c - cs.d))
+        shifts = jnp.asarray(cs.shifts, jnp.int32)
+        t_pl = cp.pallas_encode(vp, shifts, cs.sign_keys, c=cs.c, r=cs.r,
+                                m=cs.m, interpret=True)
+        np.testing.assert_allclose(np.asarray(t_pl), np.asarray(t_roll),
+                                   atol=1e-4)
+        d_pl = cp.pallas_decode(t_roll, shifts, cs.sign_keys, c=cs.c,
+                                r=cs.r, m=cs.m, interpret=True)[: cs.d]
         np.testing.assert_allclose(np.asarray(d_pl),
                                    np.asarray(cs.decode(t_roll)), atol=1e-5)
