@@ -1,0 +1,66 @@
+"""The bench resilience contract (BENCH_r02 post-mortem): transient
+remote-compile tunnel failures are retried; real bugs propagate
+immediately."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from bench_common import is_transient, with_retries
+
+
+def test_r02_failure_message_is_transient():
+    # the exact message that killed BENCH_r02
+    e = RuntimeError(
+        "INTERNAL: http://127.0.0.1:8093/remote_compile: read body: "
+        "response body closed before all bytes were read")
+    assert is_transient(e)
+
+
+def test_real_bug_is_not_transient():
+    assert not is_transient(TypeError("unsupported operand type(s)"))
+    assert not is_transient(ValueError("mode 'sketch' requires num_cols"))
+
+
+def test_with_retries_recovers_from_transient(monkeypatch):
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("remote_compile: read body: response body "
+                               "closed before all bytes were read")
+        return "ok"
+
+    monkeypatch.setattr("bench_common.time.sleep", lambda s: None)
+    assert with_retries(flaky, desc="test", tries=4) == "ok"
+    assert len(calls) == 3
+
+
+def test_with_retries_propagates_real_bug_immediately(monkeypatch):
+    calls = []
+
+    def buggy():
+        calls.append(1)
+        raise TypeError("boom")
+
+    monkeypatch.setattr("bench_common.time.sleep", lambda s: None)
+    with pytest.raises(TypeError):
+        with_retries(buggy, desc="test", tries=4)
+    assert len(calls) == 1
+
+
+def test_with_retries_exhausts_and_raises(monkeypatch):
+    calls = []
+
+    def always_flaky():
+        calls.append(1)
+        raise RuntimeError("UNAVAILABLE: connection reset by peer")
+
+    monkeypatch.setattr("bench_common.time.sleep", lambda s: None)
+    with pytest.raises(RuntimeError):
+        with_retries(always_flaky, desc="test", tries=3)
+    assert len(calls) == 3
