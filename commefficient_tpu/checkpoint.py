@@ -19,6 +19,7 @@ import dataclasses
 import json
 import os
 import shutil
+import sys
 import tempfile
 from typing import Dict, Optional
 
@@ -43,47 +44,278 @@ def params_fingerprint(params) -> str:
     return hashlib.sha256(desc.encode()).hexdigest()[:16]
 
 
-def save_state(path: str, state: FedState,
-               meta: Optional[Dict] = None) -> str:
-    """Write ``<path>.npz`` (+ ``<path>.meta.json``) atomically."""
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    arrays = {}
-    for name in _FIELDS:
-        val = getattr(state, name)
-        if val is not None:
-            arrays[name] = np.asarray(val)
+# above this many bytes of host materialization, a plain save refuses and
+# points at sharded=True (a (num_clients, d) client-state array at PERSONA
+# scale — 17,568 x 124M rows — can never pass through one np.asarray)
+DEFAULT_MAX_HOST_BYTES = int(os.environ.get(
+    "COMMEFFICIENT_CKPT_MAX_HOST_BYTES", 8 << 30))
+
+
+def _state_nbytes(state: FedState) -> int:
+    return sum(getattr(state, name).nbytes for name in _FIELDS
+               if getattr(state, name) is not None)
+
+
+def _atomic_savez(path: str, arrays: Dict) -> None:
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
                                suffix=".tmp")
     os.close(fd)
     try:
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
-        os.replace(tmp, path + ".npz")
+        os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+
+
+def _atomic_savez_stream(path: str, entries) -> None:
+    """Write an npz-compatible zip one array at a time. ``entries`` yields
+    (key, thunk-returning-ndarray); each thunk's result is written to the
+    archive and dropped before the next is produced, so peak host memory
+    is ONE entry — the point of the sharded save (np.savez would require
+    every shard of every field live in a dict simultaneously, i.e. the
+    full state the guard just refused to materialize)."""
+    import zipfile
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               suffix=".tmp")
+    os.close(fd)
+    try:
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_STORED,
+                             allowZip64=True) as zf:
+            for key, thunk in entries:
+                arr = np.asarray(thunk())
+                with zf.open(key + ".npy", "w", force_zip64=True) as f:
+                    np.lib.format.write_array(f, arr, allow_pickle=False)
+                del arr
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def save_state(path: str, state: FedState, meta: Optional[Dict] = None,
+               sharded: bool = False,
+               max_host_bytes: int = DEFAULT_MAX_HOST_BYTES) -> str:
+    """Write ``<path>.npz`` (+ ``<path>.meta.json``) atomically.
+
+    A plain save materializes every field on the host at once
+    (``np.asarray``); states whose total size exceeds ``max_host_bytes``
+    are REFUSED with a clear message instead of silently OOMing the host.
+    The escape hatch is ``sharded=True``: each device shard of each array
+    is pulled to host and written individually (peak host memory = one
+    shard), stored as ``name__shard{i}`` entries with offset metadata.
+    ``load_state`` restores a same-topology sharded checkpoint by
+    streaming each shard straight to its device (host peak = one shard);
+    cross-topology migrations fall back to host-side reassembly, which
+    does need host RAM for the full state."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if sharded:
+        # plan first (shard metadata only — shapes/offsets are free), so
+        # the coverage check runs before any data is pulled to host; then
+        # stream shard-by-shard
+        entries = [("__sharded__", lambda: np.asarray(1))]
+        for name in _FIELDS:
+            val = getattr(state, name)
+            if val is None:
+                continue
+            entries.append((f"{name}__shape",
+                            lambda v=val: np.asarray(v.shape, np.int64)))
+            entries.append((f"{name}__dtype",
+                            lambda v=val: np.asarray(str(v.dtype))))
+            shards = getattr(val, "addressable_shards", None)
+            if not shards:
+                entries.append((f"{name}__shard0",
+                                lambda v=val: np.asarray(v)))
+                entries.append((f"{name}__off0",
+                                lambda v=val: np.zeros(max(v.ndim, 1),
+                                                       np.int64)))
+                continue
+            seen = set()
+            i = 0
+            covered = 0
+            for s in shards:
+                off = tuple(sl.start or 0 for sl in s.index) or (0,)
+                if off in seen:   # replicated: one copy is enough
+                    continue
+                seen.add(off)
+                entries.append((f"{name}__shard{i}",
+                                lambda s=s: np.asarray(s.data)))
+                entries.append((f"{name}__off{i}",
+                                lambda off=off: np.asarray(off, np.int64)))
+                covered += int(np.prod(s.data.shape))
+                i += 1
+            if covered != int(np.prod(val.shape)):
+                # multi-process mesh: this host only addresses part of the
+                # array — a single-host npz would silently hold garbage
+                # for the rest (the load side also verifies coverage)
+                raise ValueError(
+                    f"sharded save of '{name}' covers only {covered} of "
+                    f"{int(np.prod(val.shape))} elements from this "
+                    "process (multi-host sharding). Per-host sharded "
+                    "checkpointing is not supported — gather to one "
+                    "process first or use a distributed checkpointer.")
+        _atomic_savez_stream(path + ".npz", entries)
+    else:
+        total = _state_nbytes(state)
+        if total > max_host_bytes:
+            raise ValueError(
+                f"checkpoint state is {total / 2**30:.1f} GiB, above the "
+                f"{max_host_bytes / 2**30:.1f} GiB single-host "
+                "materialization guard — a plain np.savez would OOM the "
+                "host at this scale. Pass sharded=True (per-shard "
+                "streaming writes, peak host memory = one shard), or "
+                "raise COMMEFFICIENT_CKPT_MAX_HOST_BYTES explicitly.")
+        arrays = {}
+        for name in _FIELDS:
+            val = getattr(state, name)
+            if val is not None:
+                arrays[name] = np.asarray(val)
+        _atomic_savez(path + ".npz", arrays)
     with open(path + ".meta.json", "w") as f:
         json.dump(meta or {}, f)
     return path + ".npz"
 
 
+def _shapes_need_migration(z, d_pad, num_clients, d_row_pad) -> bool:
+    """Whether any stored field's shape differs from the restoring
+    runtime's targets (in which case the host-side migration path must
+    run)."""
+    for name in ("ps_weights", "Vvelocity", "Verror", "coord_last_update"):
+        if d_pad is not None and f"{name}__shape" in z.files:
+            shape = tuple(z[f"{name}__shape"])
+            if len(shape) == 1 and shape[0] != d_pad:
+                return True
+    for name in ("client_velocities", "client_errors", "client_weights",
+                 "client_last_round"):
+        if f"{name}__shape" not in z.files:
+            continue
+        shape = tuple(z[f"{name}__shape"])
+        if num_clients is not None and shape[0] != num_clients:
+            return True
+        if (d_row_pad is not None and len(shape) == 2
+                and name in ("client_velocities", "client_errors")
+                and shape[1] != d_row_pad):
+            return True
+    return False
+
+
+class _LayoutMismatch(Exception):
+    pass
+
+
+def _try_streaming_restore(z, sharding) -> Optional[FedState]:
+    """Same-topology restore of a sharded checkpoint WITHOUT ever
+    materializing a full field on the host: each device shard is read
+    from the archive and placed directly (host peak = one shard). Only
+    possible when every requested device region exactly matches a stored
+    shard; returns None otherwise (caller falls back to the host path —
+    which needs host RAM for the full state, the price of cross-topology
+    migration)."""
+    fields: Dict[str, Optional[jax.Array]] = {}
+    for name in _FIELDS:
+        if f"{name}__shape" not in z.files:
+            fields[name] = None
+            continue
+        sh = getattr(sharding, name, None)
+        if sh is None:
+            return None
+        shape = tuple(int(x) for x in z[f"{name}__shape"])
+        offmap = {}
+        i = 0
+        while f"{name}__off{i}" in z.files:
+            offmap[tuple(int(o) for o in z[f"{name}__off{i}"])] = i
+            i += 1
+
+        def cb(index, name=name, offmap=offmap, shape=shape):
+            starts = tuple(sl.start or 0 for sl in index) or (0,)
+            want = tuple((sl.stop if sl.stop is not None else dim)
+                         - (sl.start or 0)
+                         for sl, dim in zip(index, shape))
+            i = offmap.get(starts if shape else (0,))
+            if i is None:
+                raise _LayoutMismatch(name)
+            arr = z[f"{name}__shard{i}"]
+            if tuple(arr.shape) != want:
+                raise _LayoutMismatch(name)
+            return arr
+
+        try:
+            fields[name] = jax.make_array_from_callback(shape, sh, cb)
+        except _LayoutMismatch:
+            return None
+    return FedState(**fields)
+
+
+def _load_arrays(path: str) -> Dict[str, Optional[np.ndarray]]:
+    """Read either npz layout back into full per-field host arrays."""
+    with np.load(path + ".npz") as z:
+        if "__sharded__" not in z.files:
+            return {name: (np.asarray(z[name]) if name in z.files else None)
+                    for name in _FIELDS}
+        kw: Dict[str, Optional[np.ndarray]] = {}
+        for name in _FIELDS:
+            if f"{name}__shape" not in z.files:
+                kw[name] = None
+                continue
+            shape = tuple(z[f"{name}__shape"])
+            out = np.empty(shape, dtype=str(z[f"{name}__dtype"]))
+            i = 0
+            covered = 0
+            while f"{name}__shard{i}" in z.files:
+                shard = z[f"{name}__shard{i}"]
+                off = tuple(z[f"{name}__off{i}"])
+                idx = tuple(slice(o, o + s)
+                            for o, s in zip(off, shard.shape))
+                out[idx if shape else ...] = shard
+                covered += int(np.prod(shard.shape))
+                i += 1
+            if covered != int(np.prod(shape)):
+                raise ValueError(
+                    f"sharded checkpoint entry '{name}' covers only "
+                    f"{covered} of {int(np.prod(shape))} elements — the "
+                    "file was written by a process that could not address "
+                    "the whole array; np.empty would silently supply "
+                    "garbage for the rest.")
+            kw[name] = out
+        return kw
+
+
 def load_state(path: str, sharding=None, d_pad: Optional[int] = None,
-               num_clients: Optional[int] = None) -> FedState:
+               num_clients: Optional[int] = None,
+               d_row_pad: Optional[int] = None) -> FedState:
     """Rebuild a FedState; optional sharding pytree (from
     ``FedRuntime._state_sharding``) places arrays sharded on load.
 
     Migrations for checkpoints written by earlier versions / other
     topologies: a missing ``nan_round`` defaults to -1; when ``d_pad``
     (the restoring runtime's padded dense length) is given, 1-D dense
-    server leaves are zero-padded or sliced to it; when ``num_clients``
+    server leaves are zero-padded or sliced to it; when ``d_row_pad``
+    (the restoring runtime's per-client dense row length — mesh-padded
+    for the column-sharded home layout) is given, 2-D velocity/error
+    rows are zero-padded or sliced along dim 1; when ``num_clients``
     (the restoring runtime's mesh-padded client count) is given,
     per-client row arrays are padded (new rows start as fresh clients:
     zero velocity/error, current PS weights, never-participated) or
     truncated — so a single-device checkpoint resumes on a mesh and vice
-    versa."""
-    with np.load(path + ".npz") as z:
-        kw = {name: (np.asarray(z[name]) if name in z.files else None)
-              for name in _FIELDS}
+    versa. Truncation is only legal for PADDING: sliced-off velocity/
+    error rows (a smaller client universe) and sliced-off row columns
+    must be all-zero, else the load raises instead of silently dropping
+    live client state.
+
+    Sharded checkpoints restoring to the SAME topology (shapes match,
+    sharding given) stream each shard straight to its device — host peak
+    = one shard, so states bigger than host RAM round-trip. Any shape
+    migration falls back to host-side reassembly."""
+    if sharding is not None:
+        with np.load(path + ".npz") as z:
+            if ("__sharded__" in z.files
+                    and not _shapes_need_migration(z, d_pad, num_clients,
+                                                   d_row_pad)):
+                state = _try_streaming_restore(z, sharding)
+                if state is not None:
+                    return state
+    kw = _load_arrays(path)
     if kw.get("nan_round") is None:
         kw["nan_round"] = np.full((), -1, np.int32)
     if d_pad is not None:
@@ -98,6 +330,25 @@ def load_state(path: str, sharding=None, d_pad: Optional[int] = None,
                 else:
                     arr = arr[:d_pad]
                 kw[name] = arr
+    if d_row_pad is not None:
+        # dense client rows: true d single-device, d_row_pad on a mesh
+        for name in ("client_velocities", "client_errors"):
+            arr = kw.get(name)
+            if arr is None or arr.ndim != 2 or arr.shape[1] == d_row_pad:
+                continue
+            if arr.shape[1] < d_row_pad:
+                arr = np.pad(arr, ((0, 0), (0, d_row_pad - arr.shape[1])))
+            else:
+                dropped = arr[:, d_row_pad:]
+                if np.any(dropped):
+                    raise ValueError(
+                        f"cannot narrow {name} rows from {arr.shape[1]} to "
+                        f"{d_row_pad}: the sliced-off columns carry "
+                        "non-zero state (a different model, not mesh "
+                        "padding). Restore with the original model "
+                        "configuration.")
+                arr = arr[:, :d_row_pad]
+            kw[name] = arr
     if num_clients is not None:
         for name in ("client_velocities", "client_errors",
                      "client_weights", "client_last_round"):
@@ -118,8 +369,23 @@ def load_state(path: str, sharding=None, d_pad: Optional[int] = None,
                     arr = np.pad(arr, pad)
             else:
                 # only mesh-padding rows (never-sampled clients) are
-                # droppable; a genuinely smaller client universe should
-                # not reuse this checkpoint
+                # droppable: dropped velocity/error must be zero — a
+                # genuinely smaller client universe loses live state and
+                # must not silently reuse this checkpoint
+                dropped = arr[num_clients:]
+                if name in ("client_velocities", "client_errors") and \
+                        np.any(dropped):
+                    raise ValueError(
+                        f"cannot truncate {name} from {arr.shape[0]} to "
+                        f"{num_clients} clients: the dropped rows carry "
+                        "non-zero velocity/error state (live clients, not "
+                        "mesh padding). Restore with num_clients >= "
+                        f"{arr.shape[0]}, or migrate explicitly.")
+                if name == "client_weights" or (
+                        name == "client_last_round" and np.any(dropped)):
+                    print(f"checkpoint: dropping {len(dropped)} "
+                          f"{name} rows (cannot verify freshness)",
+                          file=sys.stderr)
                 arr = arr[:num_clients]
             kw[name] = arr
     state = FedState(**{k: (jax.numpy.asarray(v) if v is not None else None)
@@ -141,9 +407,16 @@ class CheckpointManager:
     """Rotating checkpoints under ``directory``: ``ckpt_<epoch>``,
     keeping the newest ``keep_last``."""
 
-    def __init__(self, directory: str, keep_last: int = 3):
+    def __init__(self, directory: str, keep_last: int = 3,
+                 sharded: bool = False,
+                 max_host_bytes: int = DEFAULT_MAX_HOST_BYTES):
         self.directory = directory
         self.keep_last = keep_last
+        # save_state passthrough (drivers: --checkpoint_sharded): without
+        # this, a run whose state exceeds the host-materialization guard
+        # could never reach the advertised sharded=True escape hatch
+        self.sharded = sharded
+        self.max_host_bytes = max_host_bytes
         # merged into every save's meta (drivers put the params fingerprint
         # here so resume can detect layout changes)
         self.default_meta: Dict = {}
@@ -154,7 +427,9 @@ class CheckpointManager:
     def save(self, state: FedState, epoch: int,
              meta: Optional[Dict] = None) -> str:
         meta = dict(self.default_meta, **(meta or {}), epoch=epoch)
-        out = save_state(self._path(epoch), state, meta)
+        out = save_state(self._path(epoch), state, meta,
+                         sharded=self.sharded,
+                         max_host_bytes=self.max_host_bytes)
         self._rotate()
         return out
 
@@ -180,7 +455,7 @@ class CheckpointManager:
 
     def restore_latest(self, sharding=None, expect_fingerprint=None,
                        allow_missing_fingerprint=False, d_pad=None,
-                       num_clients=None):
+                       num_clients=None, d_row_pad=None):
         """Returns (state, meta) or (None, {}). When the caller carries a
         params fingerprint, a mismatch — or a checkpoint that predates
         fingerprinting and so carries none — raises instead of resuming into
@@ -210,4 +485,5 @@ class CheckpointManager:
                     "would unravel into the wrong weights. Re-create the "
                     "run or load with the original model configuration.")
         return load_state(self._path(e), sharding=sharding, d_pad=d_pad,
-                          num_clients=num_clients), meta
+                          num_clients=num_clients,
+                          d_row_pad=d_row_pad), meta

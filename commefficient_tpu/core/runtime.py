@@ -130,9 +130,25 @@ class FedRuntime:
             # scale a 500 MB collective where a shard-sized one suffices
             # (ref aggregation: fed_aggregator.py:326-332, 446-458).
             self.d_pad = -(-cfg.grad_size // n_dense) * n_dense
+            # Dense per-client rows (velocity/error) store COLUMN-sharded
+            # — (num_clients, d_row_pad) with the row length sharded over
+            # the clients axis — so the round's gather/scatter by
+            # client_ids is device-local and the layout change to/from
+            # per-client full rows is one all_to_all of W·d/n elements
+            # (parallel/mesh.py FedShardings.for_state; replaces the W·d
+            # all-reduce pair of the row-sharded layout — the reference
+            # analogue is zero-traffic /dev/shm rows,
+            # fed_aggregator.py:119-129). Sketch-mode table rows stay in
+            # the row layout.
+            self.d_row_pad = -(-cfg.grad_size // n_dev) * n_dev
+            self._rows_cols = (cfg.mode not in ("sketch", "fedavg")
+                               and (cfg.needs_client_velocities
+                                    or cfg.needs_client_errors))
         else:
             self.shardings = None
             self.d_pad = cfg.grad_size
+            self.d_row_pad = cfg.grad_size
+            self._rows_cols = False
         self._axis = self.shardings.axis if self.shardings else None
         self.batch_size = (cfg.local_batch_size if cfg.local_batch_size > 0
                            else cfg.max_client_batch)
@@ -195,7 +211,17 @@ class FedRuntime:
         else:
             self._round = jax.jit(self._round_step, donate_argnums=(0,))
             self._state_sharding = None
-        self._val = jax.jit(self._val_step)
+        if self.mesh is not None:
+            # mesh-parallel validation: val items are independent, so the
+            # batch shards over EVERY mesh axis (flattened) and each device
+            # evaluates its slice; per-shard means recombine as
+            # datum-weighted sums under two scalar psums. The reference
+            # instead runs val through the worker queues with no reduce
+            # (fed_aggregator.py:337-364) — here an n-device mesh evaluates
+            # n× faster instead of idling n-1 devices.
+            self._val = jax.jit(self._val_step_sharded)
+        else:
+            self._val = jax.jit(self._val_step)
 
     def _batch_pspec(self, seq_dim: Optional[int]) -> P:
         """PartitionSpec for one batch leaf: clients on dim 0, and (when
@@ -237,14 +263,18 @@ class FedRuntime:
     def _make_state(self, seed, initial_weights) -> FedState:
         cfg = self.cfg
         # Server-side transmitted-space state lives at the mesh-padded
-        # length so it shards evenly (see __init__); per-client rows are
-        # CLIENT-side quantities and stay at the true d (they are sharded
-        # over the clients axis, not the weight axis). Sketch-table shapes
-        # are unaffected. Dense pre-image states for the single-device SRHT
-        # path (see __init__) are dense too.
+        # length so it shards evenly (see __init__). Per-client dense rows
+        # are at true d single-device; on a mesh they live at d_row_pad in
+        # the COLUMN-sharded home layout (see __init__ / parallel.mesh).
+        # Sketch-table shapes are unaffected. Dense pre-image states for
+        # the single-device SRHT path (see __init__) are dense too.
         dense = self._dense_preimage or cfg.mode != "sketch"
         server_tx = (self.d_pad,) if dense else cfg.transmitted_shape
-        client_tx = (cfg.grad_size,) if dense else cfg.transmitted_shape
+        # dense client rows live at d_row_pad on a mesh (column-sharded
+        # home layout, see __init__) and at true d single-device
+        client_tx = ((self.d_row_pad,) if self._rows_cols
+                     else (cfg.grad_size,) if dense
+                     else cfg.transmitted_shape)
         d = cfg.grad_size
         n = self.num_clients
         zeros_tx = jnp.zeros(server_tx, jnp.float32)
@@ -335,6 +365,18 @@ class FedRuntime:
 
         def client_block(used_weights, batch, mask, vel_rows, err_rows,
                          client_rngs, lr, cs):
+            if self._rows_cols and self._axis is not None:
+                # home->compute layout: each device holds a (W, d_row_pad/n)
+                # column slice of all round rows; ONE all_to_all turns it
+                # into the (W/n, d_row_pad) full rows of its local clients
+                def rows_to_compute(x):
+                    full = lax.all_to_all(x, self._axis, split_axis=0,
+                                          concat_axis=1, tiled=True)
+                    return full[:, : cfg.grad_size]
+                if vel_rows is not None:
+                    vel_rows = rows_to_compute(vel_rows)
+                if err_rows is not None:
+                    err_rows = rows_to_compute(err_rows)
             if params_axis is None:
                 # clients read the (padded, possibly sharded) PS weights;
                 # the slice back to true d happens here, inside the block,
@@ -391,11 +433,34 @@ class FedRuntime:
                     # uniform by tests/test_seqparallel.py's round
                     # equivalence). The cross-shard sum above therefore
                     # over-counts by that factor once: divide it back.
+                    # JAX-VERSION DEPENDENCY: this psum->psum transpose is
+                    # the check_vma=False autodiff behavior as of jax 0.9
+                    # (with vma checking ON, a replicated param's grad
+                    # comes out full and replicated — no rescale needed).
+                    # If a jax upgrade changes it, the effective LR of
+                    # every seq-sharded run silently scales by seq_shards;
+                    # tests/test_seqparallel.py::
+                    # test_seq_sharded_round_matches_dense catches exactly
+                    # that — run it against any new jax before trusting
+                    # seq-mesh results.
                     agg = agg / self._seq_shards
                 # datum counts are identical on every seq shard (the mask
                 # replicates over seq) — sum over clients only
                 n_total = lax.psum(n_total, self._axis)
-            return agg, n_total, out.velocity, out.error, out.results, \
+            vel_out, err_out = out.velocity, out.error
+            if self._rows_cols and self._axis is not None:
+                # compute->home layout: the reverse all_to_all routes each
+                # updated row's columns back to their owning shards
+                def rows_to_home(x):
+                    xp = jnp.pad(
+                        x, ((0, 0), (0, self.d_row_pad - cfg.grad_size)))
+                    return lax.all_to_all(xp, self._axis, split_axis=1,
+                                          concat_axis=0, tiled=True)
+                if vel_out is not None:
+                    vel_out = rows_to_home(vel_out)
+                if err_out is not None:
+                    err_out = rows_to_home(err_out)
+            return agg, n_total, vel_out, err_out, out.results, \
                 out.n_valid
 
         if self._axis is not None:
@@ -406,12 +471,15 @@ class FedRuntime:
                                for k, sd in self._seq_spec.items()}
             else:
                 batch_specs = jax.tree.map(lambda _: row, batch)
+            # dense client rows arrive/leave in the column-sharded home
+            # layout (see __init__); sketch table rows keep the row layout
+            row_spec = P(None, ax) if self._rows_cols else row
             in_specs = (
                 row if params_axis == 0 else P(),
                 batch_specs,
                 row,
-                row if has_vel else None,
-                row if has_err else None,
+                row_spec if has_vel else None,
+                row_spec if has_err else None,
                 row,
                 P(),
                 jax.tree.map(lambda _: P(), cs),
@@ -423,8 +491,8 @@ class FedRuntime:
             out_specs = (
                 dense_agg_spec if cfg.mode != "sketch" else P(),
                 P(),
-                row if (cfg.mode != "fedavg" and has_vel) else None,
-                row if (cfg.mode != "fedavg" and has_err) else None,
+                row_spec if (cfg.mode != "fedavg" and has_vel) else None,
+                row_spec if (cfg.mode != "fedavg" and has_err) else None,
                 tuple(row for _ in range(cfg.num_results_train)),
                 row,
             )
@@ -473,9 +541,13 @@ class FedRuntime:
             if cfg.mode == "true_topk" and sup_mask is not None:
                 # momentum factor masking on participating clients' local
                 # velocities (intended behavior of fed_aggregator.py:528-533)
-                # — the server mask is in padded space, client rows at true d
-                new_rows = jnp.where(sup_mask[None, : cfg.grad_size],
-                                     0.0, new_rows)
+                # — the server mask is in padded space; rows are at true d
+                # single-device, at d_row_pad in the mesh home layout
+                # (padding coords are identically 0 and where() keeps them 0)
+                sm = sup_mask[: cfg.grad_size]
+                if self._rows_cols:
+                    sm = jnp.pad(sm, (0, self.d_row_pad - cfg.grad_size))
+                new_rows = jnp.where(sm[None, :], 0.0, new_rows)
             client_velocities = client_velocities.at[client_ids].set(new_rows)
         client_errors = state.client_errors
         if out.error is not None and client_errors is not None:
@@ -522,6 +594,38 @@ class FedRuntime:
         return self._val_fn_inner(ps_weights[: self.cfg.grad_size], batch,
                                   mask)
 
+    def _val_step_sharded(self, ps_weights: jax.Array, batch: Any,
+                          mask: jax.Array):
+        """Mesh-parallel val: batch items shard over every mesh axis; each
+        device evaluates its slice with the full (all-gathered) weights;
+        per-shard means recombine as valid-ITEM-weighted sums — exactly
+        the convention the host loops already use ACROSS batches
+        (run_validation / compat._call_val accumulate results*n_valid).
+        For per-item losses (CV) this equals the dense whole-batch step
+        up to fp32 reduction order (asserted by tests/test_parallel.py::
+        test_sharded_val_matches_dense). For metrics whose within-shard
+        mean is over a different unit (GPT-2's per-TOKEN lm NLL), the
+        item weighting is an approximation of the whole-batch token mean
+        — the same approximation the cross-batch accumulation already
+        makes, just at shard granularity."""
+        axes = tuple(self.mesh.axis_names)
+        nres = self.cfg.num_results_val
+
+        def block(w_shard, batch, mask):
+            w = lax.all_gather(w_shard, axes, tiled=True)
+            res, n = self._val_fn_inner(w[: self.cfg.grad_size], batch, mask)
+            num = lax.psum(jnp.stack([r * n for r in res]), axes)
+            den = lax.psum(n, axes)
+            safe = jnp.maximum(den, 1.0)
+            return tuple(num[i] / safe for i in range(len(res))), den
+
+        item = P(axes)
+        return shard_map(
+            block, mesh=self.mesh,
+            in_specs=(P(axes), jax.tree.map(lambda _: item, batch), item),
+            out_specs=(tuple(P() for _ in range(nres)), P()),
+            check_vma=False)(ps_weights, batch, mask)
+
     # -------------------------------------------------------------- user API
 
     def round(self, state: FedState, client_ids, batch, mask, lr
@@ -541,8 +645,20 @@ class FedRuntime:
 
     def val(self, state: FedState, batch, mask):
         """Masked evaluation on the current PS weights; returns
-        (results_tuple, n_valid)."""
-        return self._val(state.ps_weights, batch, jnp.asarray(mask))
+        (results_tuple, n_valid). On a mesh the batch pads up to a
+        mesh-divisible item count (padding items are masked out) and
+        shards over all devices — see _val_step_sharded."""
+        mask = jnp.asarray(mask)
+        if self.mesh is not None:
+            n = self.mesh.size
+            N = mask.shape[0]
+            Np = -(-N // n) * n
+            if Np != N:
+                batch = jax.tree.map(
+                    lambda t: jnp.pad(
+                        t, [(0, Np - N)] + [(0, 0)] * (t.ndim - 1)), batch)
+                mask = jnp.pad(mask, (0, Np - N))
+        return self._val(state.ps_weights, batch, mask)
 
     def flat_weights(self, state: FedState) -> jax.Array:
         """The true-d flat weight vector (mesh padding sliced off) — the

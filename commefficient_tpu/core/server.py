@@ -54,13 +54,22 @@ def validate_mode_combo(cfg: FedConfig) -> None:
             # diverges within tens of rounds — on every topology, with
             # either error-feedback rule. The count-sketch cell-zeroing
             # rule (circ/hash impls) dissipates k/c of the table's error
-            # mass per round and is stable; circ is the default.
-            print("WARNING: sketch_impl=rht with r*c "
-                  f"({cfg.num_rows * cfg.num_cols}) < grad_size "
-                  f"({cfg.grad_size}) diverges under error feedback in "
-                  "practice; use sketch_impl=circ (default) or hash for "
-                  "compressing configurations (rht is safe only when "
-                  "r*c >= d)")
+            # mass per round and is stable; circ is the default. Hard
+            # error by default (the repo's fail-fast philosophy);
+            # --allow_divergent_rht opts back in (e.g. to reproduce the
+            # divergence study) with a stderr warning — stdout stays
+            # machine-readable for the bench/driver contract.
+            msg = ("sketch_impl=rht with r*c "
+                   f"({cfg.num_rows * cfg.num_cols}) < grad_size "
+                   f"({cfg.grad_size}) diverges under error feedback in "
+                   "practice (measured: tests/test_learning.py); use "
+                   "sketch_impl=circ (default) or hash for compressing "
+                   "configurations — rht is safe only when r*c >= d")
+            if not cfg.allow_divergent_rht:
+                raise ValueError(
+                    msg + ". Pass --allow_divergent_rht to proceed anyway.")
+            import sys
+            print(f"WARNING: {msg}", file=sys.stderr)
         if e != "virtual":
             raise ValueError(
                 "mode=sketch requires error_type=virtual (FetchSGD). "

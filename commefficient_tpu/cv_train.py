@@ -94,15 +94,38 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
         return None, 0, None
     from commefficient_tpu.checkpoint import (CheckpointManager,
                                               params_fingerprint)
-    mgr = CheckpointManager(os.path.join(cfg.checkpoint_path, name))
+    mgr = CheckpointManager(os.path.join(cfg.checkpoint_path, name),
+                            sharded=cfg.checkpoint_sharded)
     fp = params_fingerprint(runtime.unravel(runtime.initial_weights))
-    mgr.default_meta = {"params_fingerprint": fp}
+    # sketch state (Vvelocity/Verror tables) is only meaningful under the
+    # EXACT sketch construction that encoded it: record a generation
+    # marker so a resume under different shifts/signs (e.g. the r3 change
+    # to 1024-aligned shifts for aligned num_cols) refuses instead of
+    # decoding the tables into garbage
+    sketch_gen = None
+    if cfg.mode == "sketch":
+        sketch_gen = (f"{cfg.sketch_impl}-"
+                      + ("aligned1024" if (cfg.sketch_impl == "circ"
+                                           and cfg.num_cols % 1024 == 0)
+                         else "v1")
+                      + f"-{cfg.num_rows}x{cfg.num_cols}-{cfg.sketch_seed}")
+    mgr.default_meta = {"params_fingerprint": fp, "sketch_gen": sketch_gen}
     if cfg.do_resume:
         restored, meta = mgr.restore_latest(
             sharding=runtime._state_sharding, expect_fingerprint=fp,
             allow_missing_fingerprint=cfg.resume_unverified,
-            d_pad=runtime.d_pad, num_clients=runtime.num_clients)
+            d_pad=runtime.d_pad, num_clients=runtime.num_clients,
+            d_row_pad=runtime.d_row_pad)
         if restored is not None:
+            saved_gen = meta.get("sketch_gen")
+            if saved_gen != sketch_gen and not cfg.resume_unverified:
+                raise ValueError(
+                    f"checkpoint sketch generation {saved_gen!r} does not "
+                    f"match the current construction {sketch_gen!r}: the "
+                    "saved momentum/error tables would decode under the "
+                    "wrong shifts. Re-create the run, or pass "
+                    "--resume_unverified to discard-and-continue at your "
+                    "own risk.")
             start = int(meta.get("epoch", 0))
             print(f"resumed from epoch {start}")
             return mgr, start, restored
@@ -114,6 +137,9 @@ def build_datasets(cfg: FedConfig):
     kw = {}
     if cfg.dataset_name in ("CIFAR10", "CIFAR100", "ImageNet"):
         kw["synthetic_per_class"] = cfg.synthetic_per_class
+    if cfg.dataset_name in ("CIFAR10", "CIFAR100"):
+        kw["synthetic_hard"] = cfg.synthetic_hard
+        kw["synthetic_label_noise"] = cfg.synthetic_label_noise
     if cfg.do_test:
         kw["synthetic"] = True
     train_ds = ds_cls(cfg.dataset_dir, train=True, do_iid=cfg.do_iid,

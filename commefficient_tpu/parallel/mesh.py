@@ -117,8 +117,20 @@ class FedShardings:
 
         def leaf(path, like):
             name = path[0].name
-            if name in ("client_velocities", "client_errors",
-                        "client_weights", "client_last_round"):
+            if name in ("client_velocities", "client_errors"):
+                # dense per-client rows store COLUMN-sharded (each device
+                # owns a d_row_pad/n slice of EVERY client's row): the
+                # round's row gather/scatter by client_ids is then fully
+                # local, and the compute<->home layout change is one
+                # all_to_all of W·d/n elements — replacing the W·d
+                # all-reduce pair the row-sharded layout provoked. (The
+                # TPU analogue of the reference's zero-traffic /dev/shm
+                # rows, fed_aggregator.py:119-129.) Sketch-mode rows are
+                # (r, c) tables (already ≪ d): keep them row-sharded.
+                if like.ndim == 2 and like.shape[1] % n == 0:
+                    return self._ns(None, self.axis)
+                return self.client_rows
+            if name in ("client_weights", "client_last_round"):
                 return self.client_rows
             if name in ("ps_weights", "coord_last_update", "Vvelocity",
                         "Verror"):

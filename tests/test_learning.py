@@ -126,13 +126,33 @@ def test_sketch_trains_at_real_compression(impl):
     np.testing.assert_allclose(single, mesh, rtol=1e-4)
 
 
-def test_rht_compressing_regime_warns(capsys):
-    """sketch_impl=rht sized compressing must warn loudly at runtime
-    construction (it is known-divergent there)."""
+def test_rht_compressing_regime_is_rejected(capsys):
+    """sketch_impl=rht sized compressing is known-divergent: runtime
+    construction must REFUSE it (fail-fast), and --allow_divergent_rht
+    must opt back in with a warning on STDERR (stdout is the bench/driver
+    machine-readable channel)."""
     params = {"w": jnp.zeros((40, 15), jnp.float32)}
     cfg = FedConfig(mode="sketch", error_type="virtual", local_momentum=0.0,
                     num_workers=2, local_batch_size=4, num_clients=4,
                     k=30, num_rows=4, num_cols=80, num_blocks=1,
                     sketch_impl="rht", track_bytes=False)
-    FedRuntime(cfg, params, _quad_loss, num_clients=4)
-    assert "diverges under error feedback" in capsys.readouterr().out
+    with pytest.raises(ValueError, match="diverges under error feedback"):
+        FedRuntime(cfg, params, _quad_loss, num_clients=4)
+    FedRuntime(cfg.replace(allow_divergent_rht=True), params, _quad_loss,
+               num_clients=4)
+    captured = capsys.readouterr()
+    assert "diverges under error feedback" in captured.err
+    assert "diverges" not in captured.out
+
+
+def test_flagship_model_trains_at_real_compression(tmp_path):
+    """VERDICT r2 item 7: the compressing-regime stability claim must
+    cover the flagship PATH, not just a quadratic toy — the small
+    ResNet-9 trains with the default circ sketch at r·c ≪ d."""
+    losses = run_training(
+        "sketch",
+        {"error_type": "virtual", "k": 1500, "num_rows": 3,
+         "num_cols": 5000, "num_blocks": 2},
+        tmp_path, epochs=8)
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0] * 0.8, losses

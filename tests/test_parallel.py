@@ -105,9 +105,13 @@ def test_sharded_state_layout():
     # client count padded to a multiple of the mesh axis
     assert rt.num_clients == 16
     state = rt.init_state()
+    # dense client rows store COLUMN-sharded (home layout: every device
+    # owns a d_row_pad/n slice of every row) so the round's gather/scatter
+    # by client_ids is local and layout changes are W·d/n all_to_alls
+    from jax.sharding import NamedSharding, PartitionSpec as P
     sh = state.client_errors.sharding
     assert sh.is_equivalent_to(
-        FedShardings(mesh).client_rows, state.client_errors.ndim)
+        NamedSharding(mesh, P(None, "clients")), state.client_errors.ndim)
     # dense server state shards over the weight axis even though the true
     # d (18) does not divide the mesh (padded to d_pad=24) — the VERDICT r1
     # replicated-fallback gap
@@ -117,8 +121,9 @@ def test_sharded_state_layout():
                  state.coord_last_update):
         assert leaf.shape == (24,)
         assert leaf.sharding.is_equivalent_to(fs.dense_vec, leaf.ndim)
-    # client rows stay at true d (client-side quantities)
-    assert state.client_errors.shape == (16, 18)
+    # client rows live at d_row_pad so the column sharding divides evenly
+    assert rt.d_row_pad == 24
+    assert state.client_errors.shape == (16, 24)
 
 
 def _collective_shapes(rt, state, batch, mask, client_ids):
@@ -155,23 +160,56 @@ def test_collectives_are_shard_or_table_sized(mode, extra):
     colls = _collective_shapes(rt, state, batch, mask, client_ids)
     assert colls, "expected collectives in the compiled round"
     d_pad = rt.d_pad
-    d = rt.cfg.grad_size
     table = cfg.num_rows * cfg.num_cols
-    # modes with per-client rows route W rows of length d to their home
-    # shards each round (reference analogue: worker writes into shm)
-    row_traffic = (8 * d if (cfg.needs_client_velocities
-                             or cfg.needs_client_errors) else 0)
+    # HARD bound (mirrors __graft_entry__.dryrun_multichip): every
+    # non-scalar collective result must be at most a dense shard, the
+    # sketch table, or the per-device share of the round's client-state
+    # rows (the all_to_all home-shard routing). Only the weight/top-k
+    # all-gather may be full-length. The former W·d all-reduce pair for
+    # velocity/error write-back (VERDICT r2 item 5) violates this bound.
+    row_traffic = (8 * rt.d_row_pad // 8 if (cfg.needs_client_velocities
+                                             or cfg.needs_client_errors)
+                   else 0)
+    # cfg.k covers the top-k select traffic (k ≪ a dense shard at real
+    # configs; only this tiny test config has k > d_pad/n)
+    bound = max(d_pad // 8, table if mode == "sketch" else 0, row_traffic,
+                cfg.k)
     for kind, n in colls:
-        if kind == "all-reduce":
-            # scalars (datum counts), k-sized top-k select traffic, the
-            # sketch table, or client-row writeback — NEVER the full dense
-            # gradient (the r1 gap)
-            assert (n < d_pad or (mode == "sketch" and n == table)
-                    or n == row_traffic), (kind, n)
-        elif kind == "reduce-scatter":
+        if kind == "all-gather":
+            assert n <= d_pad, (kind, n)
+        elif n > 1:
+            assert n <= bound, (kind, n)
+        if kind == "reduce-scatter":
             assert mode != "sketch" and n == d_pad // 8, (kind, n)
     if mode != "sketch":
         assert any(k == "reduce-scatter" for k, _ in colls), colls
+    if cfg.needs_client_velocities or cfg.needs_client_errors:
+        assert any(k == "all-to-all" for k, _ in colls), colls
+
+
+def test_sharded_val_matches_dense():
+    """Mesh-parallel validation (VERDICT r2 item 6): the val batch shards
+    over all devices and the weighted recombination must equal the dense
+    single-device evaluation — including a non-mesh-divisible item count
+    (padded+masked) and an odd valid-mask."""
+    cfg = make_cfg(mode="uncompressed")
+    params = {"w": jnp.asarray(
+        np.random.RandomState(0).randn(6, 3), jnp.float32)}
+    mesh = make_mesh((8,), ("clients",))
+    rt_single = FedRuntime(cfg, params, quad_loss, num_clients=16)
+    rt_mesh = FedRuntime(cfg, params, quad_loss, num_clients=16, mesh=mesh)
+    s1, s2 = rt_single.init_state(), rt_mesh.init_state()
+
+    rng = np.random.RandomState(5)
+    for N in (32, 13):  # mesh-divisible and not
+        batch = {"x": jnp.asarray(rng.randn(N, 6), jnp.float32),
+                 "y": jnp.asarray(rng.randn(N, 3), jnp.float32)}
+        mask = jnp.asarray(rng.rand(N) > 0.3)
+        r1, n1 = rt_single.val(s1, batch, mask)
+        r2, n2 = rt_mesh.val(s2, batch, mask)
+        assert float(n1) == float(n2)
+        for a, b in zip(r1, r2):
+            np.testing.assert_allclose(float(a), float(b), rtol=1e-5)
 
 
 def test_make_mesh_defaults():
