@@ -118,14 +118,25 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
             d_row_pad=runtime.d_row_pad)
         if restored is not None:
             saved_gen = meta.get("sketch_gen")
-            if saved_gen != sketch_gen and not cfg.resume_unverified:
-                raise ValueError(
-                    f"checkpoint sketch generation {saved_gen!r} does not "
-                    f"match the current construction {sketch_gen!r}: the "
-                    "saved momentum/error tables would decode under the "
-                    "wrong shifts. Re-create the run, or pass "
-                    "--resume_unverified to discard-and-continue at your "
-                    "own risk.")
+            if saved_gen != sketch_gen and sketch_gen is not None:
+                if not cfg.resume_unverified:
+                    raise ValueError(
+                        f"checkpoint sketch generation {saved_gen!r} does "
+                        f"not match the current construction "
+                        f"{sketch_gen!r}: the saved momentum/error tables "
+                        "would decode under the wrong shifts. Re-create "
+                        "the run, or pass --resume_unverified to DISCARD "
+                        "the sketch state and continue from the weights.")
+                # discard-and-continue: fresh tables, weights kept —
+                # resuming with mismatched tables would silently decode
+                # garbage every round
+                restored = restored.replace(
+                    Vvelocity=jnp.zeros_like(restored.Vvelocity),
+                    Verror=jnp.zeros_like(restored.Verror))
+                print("WARNING: sketch generation changed "
+                      f"({saved_gen!r} -> {sketch_gen!r}); momentum/error "
+                      "tables RESET, resuming from weights only",
+                      file=sys.stderr)
             start = int(meta.get("epoch", 0))
             print(f"resumed from epoch {start}")
             return mgr, start, restored
@@ -137,15 +148,35 @@ def build_datasets(cfg: FedConfig):
     kw = {}
     if cfg.dataset_name in ("CIFAR10", "CIFAR100", "ImageNet"):
         kw["synthetic_per_class"] = cfg.synthetic_per_class
+    if cfg.synthetic_hard:
+        # the flag is a CIFAR synthetic-GENERATOR knob; on any config
+        # where the generator would not run, silently proceeding would
+        # also silently disable train augmentation below — fail fast
+        if cfg.dataset_name not in ("CIFAR10", "CIFAR100"):
+            raise ValueError(
+                "--synthetic_hard is a CIFAR synthetic-generator knob; "
+                f"it does nothing for {cfg.dataset_name}")
+        if ds_cls._has_real_source(cfg.dataset_dir):
+            raise ValueError(
+                f"--synthetic_hard set but real data exists under "
+                f"{cfg.dataset_dir} (the dataset would train on it and "
+                "ignore the flag); remove the flag or point "
+                "--dataset_dir elsewhere")
     if cfg.dataset_name in ("CIFAR10", "CIFAR100"):
         kw["synthetic_hard"] = cfg.synthetic_hard
         kw["synthetic_label_noise"] = cfg.synthetic_label_noise
+    # the hard synthetic regime's class evidence is per-prototype-pixel:
+    # random-crop/flip augmentation scrambles it and training flatlines
+    # at chance (same reason tests/test_learning.py trains its synthetic
+    # runs un-augmented), so hard-mode runs train on the normalize-only
+    # transform
+    train_transform = transforms_for(
+        cfg.dataset_name, train=not cfg.synthetic_hard, seed=cfg.seed)
     if cfg.do_test:
         kw["synthetic"] = True
     train_ds = ds_cls(cfg.dataset_dir, train=True, do_iid=cfg.do_iid,
                       num_clients=cfg.num_clients,
-                      transform=transforms_for(cfg.dataset_name, True,
-                                               seed=cfg.seed), **kw)
+                      transform=train_transform, **kw)
     val_ds = ds_cls(cfg.dataset_dir, train=False,
                     transform=transforms_for(cfg.dataset_name, False), **kw)
     return train_ds, val_ds
@@ -204,7 +235,8 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
     train_store = make_device_store(
         train_ds, cfg.dataset_name, True, mesh=runtime.mesh,
         out_shardings=(runtime.batch_sharding()
-                       if runtime.mesh is not None else None))
+                       if runtime.mesh is not None else None),
+        no_augment=cfg.synthetic_hard)
     val_store = make_device_store(val_ds, cfg.dataset_name, False,
                                   mesh=runtime.mesh)
     if train_store is not None:

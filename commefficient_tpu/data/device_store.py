@@ -172,16 +172,22 @@ _AUGMENT_FOR = {
 
 def make_device_store(dataset, dataset_name: str, train: bool,
                       max_bytes: int = 2 << 30,
-                      mesh=None, out_shardings=None) -> Optional[DeviceStore]:
+                      mesh=None, out_shardings=None,
+                      no_augment: bool = False) -> Optional[DeviceStore]:
     """Build a DeviceStore for a FedDataset when its arrays fit on device
     and the dataset's transform has a device equivalent; None => use the
     host pipeline. With a ``mesh``, arrays replicate across it and train
-    batches come out sharded over the round's client axis."""
+    batches come out sharded over the round's client axis.
+    ``no_augment``: train batches get normalize-only (the hard synthetic
+    regime's per-pixel class evidence does not survive crop/flip —
+    cv_train.build_datasets)."""
     from commefficient_tpu.data import transforms as T
 
     if dataset_name not in _AUGMENT_FOR:
         return None
     aug, const = _AUGMENT_FOR[dataset_name]
+    if no_augment and aug not in (None, "host"):
+        aug = "normalize"
     if train and aug == "host":
         return None
     mean = getattr(T, f"{const}_MEAN", None) if const else None

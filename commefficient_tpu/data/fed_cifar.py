@@ -32,11 +32,18 @@ from commefficient_tpu.data.fed_dataset import FedDataset
 # train/val share class prototypes (val differs only in noise)
 _SYNTH_PROTOS = "shared-v2"
 
-# hard-regime amplitudes (see _synthetic_cifar hard=True): class-delta
-# and per-image-noise, calibrated (TPU sweep, 10-epoch ResNet-9 probes:
-# delta 45 saturates by epoch 9, delta 18 crawls at ~25%) so a 24-epoch
-# ResNet-9 run lands well below 100% val accuracy and is still climbing
-_HARD_DELTA = 24
+# hard-regime knobs (see _synthetic_cifar hard=True), calibrated by TPU
+# sweeps so a 24-epoch ResNet-9 run lands well below 100% val accuracy
+# and is still climbing. The class evidence is SPARSE (a _HARD_FRAC
+# subset of pixels carries a strong ±_HARD_DELTA offset): gradients then
+# have heavy hitters, the structure FetchSGD-style top-k/sketch methods
+# target. (A first, uniform-evidence design — every pixel carrying a
+# faint delta — was measured top-k-ADVERSARIAL: uncompressed reached 95%
+# while sketch/top-k stalled at ~20%, because no coordinate mattered
+# more than any other and only k/d of a uniformly-informative gradient
+# survives sparsification.)
+_HARD_FRAC = 0.15
+_HARD_DELTA = 60
 _HARD_NOISE = 70
 
 
@@ -65,11 +72,13 @@ def _synthetic_cifar(num_classes: int, per_class: int, img_hw: int = 32,
     prng = np.random.RandomState(proto_seed)
     if hard:
         # base in the mid-range so delta+noise rarely clip (clipping at
-        # 0/255 would destroy the low-amplitude class signal)
+        # 0/255 would destroy the class signal); sparse heavy-tailed
+        # class evidence — see the _HARD_* constants' rationale
         base = prng.randint(70, 185, size=(1, img_hw, img_hw, 3))
-        deltas = prng.randint(-_HARD_DELTA, _HARD_DELTA,
-                              size=(num_classes, img_hw, img_hw, 3))
-        protos = np.clip(base + deltas, 0, 255)
+        where = prng.rand(num_classes, img_hw, img_hw, 1) < _HARD_FRAC
+        signs = prng.choice([-1, 1],
+                            size=(num_classes, img_hw, img_hw, 3))
+        protos = np.clip(base + where * signs * _HARD_DELTA, 0, 255)
         noise_amp = _HARD_NOISE
     else:
         protos = prng.randint(0, 255, size=(num_classes, img_hw, img_hw, 3))
@@ -143,15 +152,19 @@ class FedCIFAR10(FedDataset):
                       "settings")
         super().__init__(*args, **kw)
 
-    def _has_real_source(self, dataset_dir: str) -> bool:
-        return os.path.isdir(os.path.join(dataset_dir, self._pickle_dir))
+    @classmethod
+    def _has_real_source(cls, dataset_dir: str) -> bool:
+        return os.path.isdir(os.path.join(dataset_dir, cls._pickle_dir))
 
     def _synth_marker(self) -> dict:
         """Everything a synthetic prep bakes into its arrays — ANY field
         change must invalidate the cache (subclasses add their knobs)."""
         return {"per_class": self._synthetic_per_class,
                 "protos": _SYNTH_PROTOS,
-                "hard": self._synthetic_hard,
+                # the hard marker carries the regime knobs: retuning them
+                # must invalidate previously prepared arrays
+                "hard": ([_HARD_FRAC, _HARD_DELTA, _HARD_NOISE]
+                         if self._synthetic_hard else False),
                 "label_noise": self._synthetic_label_noise}
 
     # --------------------------------------------------------- preparation

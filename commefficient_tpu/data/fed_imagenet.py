@@ -34,7 +34,8 @@ class FedImageNet(FedCIFAR10):
         self._synthetic_num_classes = synthetic_num_classes
         super().__init__(*args, **kw)
 
-    def _has_real_source(self, dataset_dir: str) -> bool:
+    @classmethod
+    def _has_real_source(cls, dataset_dir: str) -> bool:
         return os.path.isdir(os.path.join(dataset_dir, "train"))
 
     def _synth_marker(self) -> dict:

@@ -65,6 +65,11 @@ def test_factory_gating(tmp_path):
     assert make_device_store(ds, "NOPE", train=True) is None
     # too big => host fallback
     assert make_device_store(ds, "CIFAR10", train=True, max_bytes=10) is None
+    # hard synthetic regime: train batches must be normalize-only (crop/
+    # flip scrambles the per-pixel class evidence — cv_train passes
+    # no_augment=cfg.synthetic_hard)
+    st = make_device_store(ds, "CIFAR10", train=True, no_augment=True)
+    assert st is not None and st.augment == "normalize"
 
 
 def test_mesh_store_shards_round_batches():

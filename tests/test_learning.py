@@ -145,6 +145,46 @@ def test_rht_compressing_regime_is_rejected(capsys):
     assert "diverges" not in captured.out
 
 
+def test_imagenet_pipeline_end_to_end_rounds(tmp_path):
+    """FedImageNet's synthetic path through real federated rounds (not
+    just prepare/ingest): per-wnid natural clients, sampler, sketch
+    round, and validation all compose — the CPU-sized stand-in for the
+    ImageNet recipe (scripts/imagenet.sh)."""
+    from commefficient_tpu.data import FedSampler
+    from commefficient_tpu.data.fed_imagenet import FedImageNet
+
+    ds = FedImageNet(str(tmp_path), train=True, synthetic=True,
+                     image_size=32, synthetic_num_classes=4,
+                     synthetic_per_class=8,
+                     transform=transforms_for("CIFAR10", False))
+    val = FedImageNet(str(tmp_path), train=False, synthetic=True,
+                      image_size=32, synthetic_num_classes=4,
+                      synthetic_per_class=8,
+                      transform=transforms_for("CIFAR10", False))
+    cfg = FedConfig(mode="sketch", error_type="virtual", local_momentum=0.0,
+                    virtual_momentum=0.9, weight_decay=0.0, num_workers=2,
+                    local_batch_size=4, k=50, num_rows=3, num_cols=512,
+                    num_blocks=2, num_clients=ds.num_clients,
+                    track_bytes=False, compute_dtype="float32")
+    model = models.ResNet9(num_classes=4, channels=SMALL,
+                           do_batchnorm=True)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 32, 32, 3)))
+    rt = FedRuntime(cfg, params, make_cv_loss(model, "float32"),
+                    num_clients=ds.num_clients)
+    state = rt.init_state()
+    sampler = FedSampler(ds.data_per_client, cfg.num_workers,
+                         cfg.local_batch_size, seed=0)
+    for rnd in sampler:
+        batch = {k: jnp.asarray(v) for k, v in ds.gather(rnd.idx).items()}
+        state, m = rt.round(state, rnd.client_ids, batch, rnd.mask, 0.05)
+        break
+    assert np.isfinite(np.asarray(m["results"][0])).all()
+    vb = {k: jnp.asarray(v)
+          for k, v in val.gather(np.arange(8)).items()}
+    res, n = rt.val(state, vb, jnp.ones((8,), bool))
+    assert np.isfinite(float(res[0]))
+
+
 def test_flagship_model_trains_at_real_compression(tmp_path):
     """VERDICT r2 item 7: the compressing-regime stability claim must
     cover the flagship PATH, not just a quadratic toy — the small
