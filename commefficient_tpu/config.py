@@ -178,6 +178,9 @@ class FedConfig:
     compilation_cache_dir: str = "~/.cache/commefficient_tpu_xla"
     # rematerialize transformer blocks on backward (memory/FLOPs trade)
     do_remat: bool = False
+    # selective-remat policy (jax.checkpoint_policies attribute name, e.g.
+    # dots_with_no_batch_dims_saveable) applied when do_remat; "" = full
+    remat_policy: str = ""
     # chunked LM cross-entropy: compute vocab logits ``lm_chunk`` tokens at
     # a time under jax.checkpoint instead of materializing the full
     # (tokens, vocab) fp32 tensor (+ cotangent) — the GPT-2 microbatch-8
@@ -352,6 +355,7 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                    default="~/.cache/commefficient_tpu_xla",
                    help="persistent XLA compile cache; empty disables")
     p.add_argument("--remat", action="store_true", dest="do_remat")
+    p.add_argument("--remat_policy", type=str, default="")
     p.add_argument("--lm_chunk", type=int, default=0)
     return parser
 

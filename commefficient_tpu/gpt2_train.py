@@ -50,11 +50,14 @@ PERSONA_SEQ_SPEC = {"input_ids": 3, "token_type_ids": 3, "lm_labels": 3,
 def build_gpt2(cfg: FedConfig, tokenizer):
     n_vocab = len(tokenizer)
     if cfg.do_test:
-        gcfg = GPT2Config.small(vocab_size=n_vocab - 5)
+        gcfg = GPT2Config.small(vocab_size=n_vocab - 5,
+                                remat=cfg.do_remat,
+                                remat_policy=cfg.remat_policy)
     else:
         gcfg = GPT2Config(vocab_size=n_vocab - 5,
                           compute_dtype=jnp.dtype(cfg.compute_dtype),
-                          remat=cfg.do_remat)
+                          remat=cfg.do_remat,
+                          remat_policy=cfg.remat_policy)
     return GPT2DoubleHeads(gcfg), gcfg
 
 

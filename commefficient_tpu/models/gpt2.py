@@ -44,6 +44,11 @@ class GPT2Config:
     # rematerialize each block on the backward pass (jax.checkpoint):
     # trades recompute FLOPs for HBM — the standard long-context memory move
     remat: bool = False
+    # selective-remat policy name (jax.checkpoint_policies attribute, e.g.
+    # "dots_with_no_batch_dims_saveable"): save matmul outputs, recompute
+    # the cheap elementwise rest — spends a little of the memory remat
+    # freed to skip most of the recompute FLOPs. Empty = full remat.
+    remat_policy: str = ""
     # lax.scan over the layer stack (stacked block params) instead of
     # unrolling n_layer blocks into the graph: XLA compiles ONE block body,
     # cutting compile time ~n_layer-fold for deep models — essential when
@@ -153,7 +158,19 @@ class GPT2Backbone(nn.Module):
             attn = functools.partial(ring_attention_inner,
                                      axis_name=self.seq_axis,
                                      num_shards=self.seq_shards)
-        block_cls = nn.remat(Block) if cfg.remat else Block
+        if cfg.remat and cfg.remat_policy:
+            if not hasattr(jax.checkpoint_policies, cfg.remat_policy):
+                raise ValueError(
+                    f"unknown remat_policy {cfg.remat_policy!r}: must be "
+                    "an attribute of jax.checkpoint_policies (e.g. "
+                    "dots_with_no_batch_dims_saveable)")
+            block_cls = nn.remat(
+                Block,
+                policy=getattr(jax.checkpoint_policies, cfg.remat_policy))
+        elif cfg.remat:
+            block_cls = nn.remat(Block)
+        else:
+            block_cls = Block
         if cfg.scan_layers:
             scanned = nn.scan(
                 _ScanBody, variable_axes={"params": 0},
