@@ -40,7 +40,13 @@ time), so every ``jnp.roll`` compiles to two contiguous slices + concat —
 pure HBM-bandwidth data movement, no scatter, no gather, no sort. Encode =
 r·(sign-multiply + m static rolls + reduce); decode = r·m static rolls of
 the (c,) table rows + sign-multiply + median-of-r comparator network.
-Measured at the flagship config: ~2 ms vs the hash impl's ~250 ms per op.
+Measured at the flagship CV config: ~5 ms vs the hash impl's ~250 ms per
+op. When c % 1024 == 0 the shifts are additionally drawn at vreg
+granularity (see ``make_circulant_sketch`` for why the statistics are
+unchanged) and decode runs as a fused Pallas kernel
+(ops/circulant_pallas.py — 21 ms vs the roll path's 129 ms at the GPT-2
+scale d=124M, where r·m static roll OPS otherwise dominate at ~70 us of
+fixed XLA per-op cost each).
 
 Error feedback: a k-sparse update encodes into <= k·r occupied cells, and
 ``dense_transform = False``, so the server applies the reference's exact
