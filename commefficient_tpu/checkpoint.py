@@ -314,6 +314,14 @@ def load_state(path: str, sharding=None, d_pad: Optional[int] = None,
                                                    d_row_pad)):
                 state = _try_streaming_restore(z, sharding)
                 if state is not None:
+                    # apply the same missing-field migration defaults as
+                    # the host path below — the two restore paths must not
+                    # drift (a file missing nan_round must come back as
+                    # -1, not None, either way)
+                    if state.nan_round is None:
+                        state = dataclasses.replace(
+                            state, nan_round=jax.numpy.full((), -1,
+                                                            jax.numpy.int32))
                     return state
     kw = _load_arrays(path)
     if kw.get("nan_round") is None:

@@ -157,7 +157,7 @@ class FedRuntime:
             self.cs = make_sketch_impl(
                 cfg.sketch_impl, cfg.grad_size, cfg.num_cols, cfg.num_rows,
                 cfg.num_blocks, seed=cfg.sketch_seed, dtype=cfg.sketch_dtype,
-                scan_rows=cfg.sketch_scan_rows)
+                scan_rows=cfg.sketch_scan_rows, pallas=cfg.pallas)
         # Sketch linearity: sum-of-client-sketches == sketch-of-summed-grads,
         # so the O(d·r) encode can run once per round instead of once per
         # client — unless a per-client nonlinearity (table clip) intervenes.

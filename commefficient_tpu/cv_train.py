@@ -120,6 +120,20 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
             saved_gen = meta.get("sketch_gen")
             if saved_gen != sketch_gen and sketch_gen is not None:
                 if not cfg.resume_unverified:
+                    if saved_gen is None:
+                        # pre-marker checkpoints are UNVERIFIABLE, not
+                        # known-mismatched: that era could write any
+                        # sketch_impl/seed with the same (r, c) shapes,
+                        # so the tables may or may not decode correctly —
+                        # refuse with wording that says so
+                        raise ValueError(
+                            "checkpoint predates sketch-generation "
+                            "markers, so its momentum/error tables "
+                            "cannot be verified against the current "
+                            f"construction {sketch_gen!r} (the writing "
+                            "run's sketch_impl/seed were not recorded). "
+                            "Pass --resume_unverified to DISCARD the "
+                            "sketch state and continue from the weights.")
                     raise ValueError(
                         f"checkpoint sketch generation {saved_gen!r} does "
                         f"not match the current construction "

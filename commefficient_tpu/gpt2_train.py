@@ -37,7 +37,7 @@ from commefficient_tpu.models.gpt2 import (
     GPT2DoubleHeads,
     load_hf_weights,
 )
-from commefficient_tpu.utils import TableLogger, Timer
+from commefficient_tpu.utils import TableLogger, TSVLogger, Timer
 
 
 # batch leaf -> index of its sequence dimension in the per-round arrays
@@ -143,7 +143,7 @@ def main(argv=None):
 
     timer = Timer()
     tokenizer = get_tokenizer(cfg.model_checkpoint)
-    max_seq_len = 64 if cfg.do_test else 280
+    max_seq_len = cfg.max_seq_len or (64 if cfg.do_test else 280)
     train_ds = FedPERSONA(cfg.dataset_dir, train=True, do_iid=cfg.do_iid,
                           num_clients=cfg.num_clients, tokenizer=tokenizer,
                           num_candidates=cfg.num_candidates,
@@ -216,12 +216,14 @@ def main(argv=None):
         state = restored
 
     from commefficient_tpu.cv_train import make_writer
+    tsv = TSVLogger()
     state, summary = shared_train(cfg, runtime, state, train_ds, val_ds,
-                                  loggers=(TableLogger(),), timer=timer,
+                                  loggers=(TableLogger(), tsv), timer=timer,
                                   ckpt_mgr=ckpt_mgr,
                                   start_epoch=start_epoch,
                                   schedule=make_gpt2_schedule(cfg),
                                   writer=make_writer(cfg))
+    print(tsv)
 
     if summary is not None:
         nll = summary["test_loss"]

@@ -57,7 +57,6 @@ as the hash impl (validated at r·c << d in tests/test_learning.py).
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Optional, Tuple
 
 import jax
@@ -87,12 +86,17 @@ class CirculantSketch:
     c: int
     r: int
     num_blocks: int                 # decode memory chunking over the m axis
+    # pallas kernel policy (config.py --pallas): "auto" = fused decode when
+    # eligible (the measured win), "on" = also the pallas encode (measured
+    # ~equal to the XLA static-roll encode), "off" = XLA paths only
+    pallas: str = "auto"
 
     dense_transform = False
 
     def tree_flatten(self):
         return ((self.sign_keys,),
-                (self.shifts, self.d, self.c, self.r, self.num_blocks))
+                (self.shifts, self.d, self.c, self.r, self.num_blocks,
+                 self.pallas))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -152,8 +156,8 @@ class CirculantSketch:
         c % 1024 == 0 — the reference's default c=500,000 = 2^5·5^6 can
         never align; pick e.g. --num_cols 524288), and the wrap-padded
         table within the decode kernel's VMEM residency budget.
-        ``COMMEFFICIENT_PALLAS=0`` disables outright."""
-        if (self.m <= 1 or os.environ.get("COMMEFFICIENT_PALLAS") == "0"
+        ``--pallas off`` disables outright."""
+        if (self.m <= 1 or self.pallas == "off"
                 or jax.default_backend() != "tpu"):
             return False
         from commefficient_tpu.ops.circulant_pallas import (
@@ -174,9 +178,8 @@ class CirculantSketch:
         # the static-roll XLA encode is already ~26 ms (the shifts are
         # trace-time constants, compiled to fixed slices); the pallas
         # encode re-reads the input nct times and lands ~equal, so it
-        # stays opt-in
-        return (os.environ.get("COMMEFFICIENT_PALLAS") == "1"
-                and self._pallas_eligible())
+        # stays opt-in (--pallas on)
+        return self.pallas == "on" and self._pallas_eligible()
 
     def encode(self, vec: jax.Array) -> jax.Array:
         assert vec.ndim == 1 and vec.shape[0] == self.d, (vec.shape, self.d)
@@ -265,23 +268,43 @@ class CirculantSketch:
 
 
 def make_circulant_sketch(d: int, c: int, r: int, num_blocks: int = 1,
-                          seed: int = 42) -> CirculantSketch:
+                          seed: int = 42,
+                          pallas: str = "auto") -> CirculantSketch:
     """Shift granularity: when c % 1024 == 0, shifts are drawn as uniform
     MULTIPLES of 1024 (= 8 sublanes x 128 lanes). That makes every span
     of a per-block roll start on a TPU vreg boundary, which is what lets
     the pallas decode kernel extract it with one sublane-dynamic slice
     instead of a dynamic rotate (ops/circulant_pallas.py v4 — measured
-    6x). Statistics are unchanged in the quantities that matter: two
-    coordinates i (block b), i' (block b') collide iff
-    s_b − s_b' ≡ i' − i (mod c), which under 1024-granular shifts has
+    6x). Statistics under the coarser shifts: two coordinates i (block
+    b), i' (block b') collide iff s_b − s_b' ≡ i' − i (mod c), which has
     probability 1024/c when i ≡ i' (mod 1024) and 0 otherwise — the
-    bucket map partitions coordinates into residue classes, colliding
-    1024x more often within a class and never across, so the per-row
-    estimate variance stays ≤ ||v||²/c in expectation and rows remain
-    independent: the CountSketch median guarantee is untouched. (Same-
-    block coordinates still never collide.)"""
+    bucket map partitions coordinates into residue classes mod 1024,
+    colliding 1024x more often within a class and never across. Averaged
+    over coordinates the per-row estimate variance is still ≤ ||v||²/c,
+    but it is NOT the per-pair 1/c bound: a vector whose heavy
+    coordinates concentrate in one residue class sees up to 1024x the
+    per-row variance, and because the class partition is shared by every
+    row (alignment is what the pallas kernel needs, so it cannot be
+    de-correlated per row), the median over rows does not restore the
+    worst case. Model gradients have no mechanism tying magnitude to
+    i mod 1024 of the flattened parameter index, which is why the
+    aligned construction is the default for aligned c — but a user who
+    needs the exact CountSketch per-pair guarantee should pick an
+    unaligned c (e.g. the reference's 500,000), which keeps 1-granular
+    shifts at the cost of the fused pallas decode. (Same-block
+    coordinates still never collide, in either construction.)"""
     rng = np.random.RandomState(seed)
     m = -(-d // c)
+    if m > CirculantSketch._UNROLL_MAX_BLOCKS:
+        import warnings
+        warnings.warn(
+            f"circulant sketch with m = ceil(d/c) = {m} blocks exceeds "
+            f"_UNROLL_MAX_BLOCKS={CirculantSketch._UNROLL_MAX_BLOCKS}: "
+            "encode/decode fall back from static rolls to a "
+            "take_along_axis gather, which is ~100x slower on TPU "
+            "(measured 2,673 ms/op at d=124M in the gather regime vs "
+            "26 ms static-roll encode). Increase num_cols so that "
+            "d/num_cols <= 512.", stacklevel=2)
     if c % 1024 == 0:
         shifts = tuple(
             tuple(int(s) * 1024 for s in rng.randint(0, c // 1024, size=m))
@@ -292,4 +315,4 @@ def make_circulant_sketch(d: int, c: int, r: int, num_blocks: int = 1,
     sign_keys = rng.randint(0, 2**32, size=(r,),
                             dtype=np.uint64).astype(np.uint32) | 1
     return CirculantSketch(jnp.asarray(sign_keys), shifts, d=d, c=c, r=r,
-                           num_blocks=num_blocks)
+                           num_blocks=num_blocks, pallas=pallas)

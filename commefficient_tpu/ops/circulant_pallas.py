@@ -33,8 +33,9 @@ Used AUTOMATICALLY for decode on TPU when the sketch's shifts are
 encode keeps the static-roll XLA path by default (26 ms — the rolls are
 trace-time constants there, which XLA compiles to fixed slices; the
 pallas encode re-reads the input nct times and lands at ~the same
-cost). ``COMMEFFICIENT_PALLAS=0`` disables, ``=1`` also forces the
-pallas encode. Replaces the external CUDA CSVec hot path (reference
+cost). The ``--pallas`` config flag controls the policy: ``off``
+disables, ``on`` also forces the pallas encode, ``auto`` (default) is
+decode-only. Replaces the external CUDA CSVec hot path (reference
 fed_worker.py:312-320).
 """
 

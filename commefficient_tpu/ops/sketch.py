@@ -127,13 +127,14 @@ def make_sketch(d: int, c: int, r: int, num_blocks: int = 1,
 
 def make_sketch_impl(impl: str, d: int, c: int, r: int, num_blocks: int = 1,
                      seed: int = 42, dtype: str = "float32",
-                     scan_rows: int = -1):
+                     scan_rows: int = -1, pallas: str = "auto"):
     """Factory over the three sketch implementations: ``"circ"`` (circulant
     count sketch — stable cell-zeroing semantics AND scatter-free TPU speed,
     the default), ``"hash"`` (count sketch, exact CSVec semantics) or
     ``"rht"`` (SRHT, MXU matmuls; lossless-regime only — see ops/rht.py).
     ``dtype`` selects the rht transform compute dtype; ``scan_rows``: -1
-    auto, 0 force batched, 1 force row-scanned."""
+    auto, 0 force batched, 1 force row-scanned; ``pallas`` is the circ
+    impl's kernel policy (config.py --pallas: auto/on/off)."""
     if impl == "rht":
         from commefficient_tpu.ops.rht import make_rht_sketch
         return make_rht_sketch(d, c, r, seed=seed, dtype=dtype,
@@ -143,7 +144,8 @@ def make_sketch_impl(impl: str, d: int, c: int, r: int, num_blocks: int = 1,
         return make_sketch(d, c, r, num_blocks, seed=seed)
     if impl == "circ":
         from commefficient_tpu.ops.circulant import make_circulant_sketch
-        return make_circulant_sketch(d, c, r, num_blocks, seed=seed)
+        return make_circulant_sketch(d, c, r, num_blocks, seed=seed,
+                                     pallas=pallas)
     raise ValueError(
         f"unknown sketch_impl {impl!r} (want 'circ', 'hash' or 'rht')")
 

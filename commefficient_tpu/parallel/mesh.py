@@ -115,6 +115,16 @@ class FedShardings:
         n = self.mesh.shape[self.axis]
         n_dense = self.mesh.size
 
+        # the column-sharded home layout applies exactly when the runtime's
+        # round program expects it (FedRuntime._rows_cols): dense-row modes
+        # with per-client velocity/error rows. Deciding here by shape alone
+        # could disagree with the round's shard_map in_specs (forcing a
+        # hidden W·d reshard every round), so both sides derive the
+        # predicate from cfg.
+        rows_cols = (cfg.mode not in ("sketch", "fedavg")
+                     and (cfg.needs_client_velocities
+                          or cfg.needs_client_errors))
+
         def leaf(path, like):
             name = path[0].name
             if name in ("client_velocities", "client_errors"):
@@ -127,7 +137,10 @@ class FedShardings:
                 # TPU analogue of the reference's zero-traffic /dev/shm
                 # rows, fed_aggregator.py:119-129.) Sketch-mode rows are
                 # (r, c) tables (already ≪ d): keep them row-sharded.
-                if like.ndim == 2 and like.shape[1] % n == 0:
+                if rows_cols:
+                    assert like.ndim == 2 and like.shape[1] % n == 0, (
+                        f"{name}: home layout needs a (clients, d_row_pad) "
+                        f"row with n | d_row_pad, got {like.shape}")
                     return self._ns(None, self.axis)
                 return self.client_rows
             if name in ("client_weights", "client_last_round"):

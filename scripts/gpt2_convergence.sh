@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# GPT-2 federated convergence artifact (VERDICT r3 item 1): from-scratch
+# GPT-2 (12L/768, vocab = offline HashTokenizer) on the structured
+# synthetic PersonaChat corpus (scripts/make_persona_corpus.py — real
+# personachat_self_original.json format, 256 personality clients), three
+# complete 24-epoch runs on one TPU chip: flagship sketch (5x524288,
+# k=50k, d=92.1M — 35x compression) vs true_topk vs uncompressed.
+# Reference lineage: gpt2_train.py:115-149 (train loop), 55-86 (eval).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT=runs/gpt2_conv
+mkdir -p "$OUT"
+[ -f "$OUT/data/personachat_self_original.json" ] || \
+    python scripts/make_persona_corpus.py "$OUT/data"
+
+COMMON=(--num_epochs 24 --num_workers 8 --local_batch_size 8
+        --microbatch_size 8 --max_seq_len 64 --valid_batch_size 64
+        --weight_decay 0 --local_momentum 0 --virtual_momentum 0.9
+        --eval_before_start --dataset_dir "$OUT/data" --seed 21)
+
+run() {
+    local name=$1; shift
+    echo "=== $name ==="
+    python gpt2_train.py "$@" "${COMMON[@]}" 2>&1 | tee "$OUT/$name.log"
+    # per-epoch TSV artifact: epoch, hours, test NLL, ppl, MC accuracy
+    python - "$OUT/$name.log" "$OUT/$name.tsv" <<'EOF'
+import math, re, sys
+rows = ["epoch\thours\ttest_nll\tppl\tmc_acc"]
+for line in open(sys.argv[1]):
+    f = line.split()
+    # TableLogger rows: epoch lr train_time train_loss train_acc
+    #                   test_loss test_acc down up total_time
+    if len(f) == 10 and re.fullmatch(r"\d+", f[0]):
+        ep, nll, acc, total = int(f[0]), float(f[5]), float(f[6]), float(f[9])
+        rows.append(f"{ep}\t{total/3600:.8f}\t{nll:.4f}"
+                    f"\t{math.exp(min(nll, 20)):.2f}\t{acc:.4f}")
+with open(sys.argv[2], "w") as out:
+    out.write("\n".join(rows) + "\n")
+print("wrote", sys.argv[2])
+EOF
+}
+
+run gpt2_sketch24 --mode sketch --error_type virtual \
+    --num_cols 524288 --num_rows 5 --k 50000 --approx_topk
+run gpt2_true_topk24 --mode true_topk --error_type virtual \
+    --k 50000 --approx_topk
+run gpt2_uncompressed24 --mode uncompressed --error_type none
+echo "ALL DONE"

@@ -168,6 +168,105 @@ def test_persona_real_corpus_with_real_bpe(tmp_path):
     assert len(val) == 1                       # one valid-split utterance
 
 
+# ----------------------------------------------------------- LEAF EMNIST
+
+
+def _write_leaf_femnist(root, seed=3):
+    """Tiny LEAF FEMNIST tree in the reference's exact on-disk format
+    (reference fed_emnist.py:95-123 reads train/ and test/ directories of
+    ``all_data_*.json`` files, each ``{"users": [...], "num_samples":
+    [...], "user_data": {user: {"x": [784-float lists], "y": [ints]}}}``).
+    Train data is spread over TWO json files to exercise the multi-file
+    concatenation."""
+    rng = np.random.RandomState(seed)
+
+    def blob(users, per):
+        user_data = {}
+        for u, n in zip(users, per):
+            user_data[u] = {
+                "x": rng.rand(n, 784).round(4).tolist(),
+                "y": [int(t) for t in rng.randint(0, 62, n)],
+            }
+        return {"users": users, "num_samples": per, "user_data": user_data}
+
+    os.makedirs(os.path.join(root, "train"), exist_ok=True)
+    os.makedirs(os.path.join(root, "test"), exist_ok=True)
+    train_blobs = [blob(["f0000_01", "f0001_02"], [6, 4]),
+                   blob(["f0002_03"], [5])]
+    for i, b in enumerate(train_blobs):
+        with open(os.path.join(root, "train", f"all_data_{i}.json"),
+                  "w") as f:
+            json.dump(b, f)
+    with open(os.path.join(root, "test", "all_data_0.json"), "w") as f:
+        json.dump(blob(["f0000_01", "f0002_03"], [3, 2]), f)
+    return train_blobs
+
+
+def test_leaf_emnist_ingest_and_round(tmp_path):
+    """The real LEAF json branch (_read_leaf) end to end: per-writer
+    natural clients with exact pixel round-trip, then a federated sketch
+    round + validation over the ingested data (VERDICT r3 item 5)."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu import models
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.core import FedRuntime
+    from commefficient_tpu.data import FedSampler, transforms_for
+    from commefficient_tpu.data.fed_emnist import FedEMNIST
+    from commefficient_tpu.losses import make_cv_loss
+
+    train_blobs = _write_leaf_femnist(str(tmp_path))
+    ds = FedEMNIST(str(tmp_path))            # synthetic=None, LEAF found
+    assert ds.num_clients == 3               # writers across both files
+    assert ds.images_per_client.tolist() == [6, 4, 5]
+    # exact round-trip of the first writer's pixels and labels, in order
+    b = ds.gather(np.arange(6))
+    ud = train_blobs[0]["user_data"]["f0000_01"]
+    np.testing.assert_allclose(
+        b["image"].reshape(6, -1), np.asarray(ud["x"], np.float32),
+        rtol=0, atol=1e-6)
+    np.testing.assert_array_equal(b["target"], ud["y"])
+    val = FedEMNIST(str(tmp_path), train=False)
+    assert len(val) == 5                     # test-split samples pooled
+
+    # a real federated round over the ingested clients
+    tf = transforms_for("EMNIST", train=False)
+    cfg = FedConfig(mode="sketch", error_type="virtual", local_momentum=0.0,
+                    virtual_momentum=0.9, weight_decay=0.0, num_workers=2,
+                    local_batch_size=4, k=50, num_rows=3, num_cols=512,
+                    num_blocks=2, num_clients=ds.num_clients,
+                    dataset_name="EMNIST", track_bytes=False,
+                    compute_dtype="float32")
+    model = models.ResNet9(num_classes=62,
+                           channels={"prep": 2, "layer1": 2, "layer2": 2,
+                                     "layer3": 2}, do_batchnorm=True)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 28, 28, 1)))
+    rt = FedRuntime(cfg, params, make_cv_loss(model, "float32"),
+                    num_clients=ds.num_clients)
+    state = rt.init_state()
+    for rnd in FedSampler(ds.data_per_client, cfg.num_workers,
+                          cfg.local_batch_size, seed=0):
+        batch = {k: jnp.asarray(v) for k, v in tf(ds.gather(rnd.idx)).items()}
+        state, m = rt.round(state, rnd.client_ids, batch, rnd.mask, 0.05)
+        break
+    assert np.isfinite(np.asarray(m["results"][0])).all()
+    vb = {k: jnp.asarray(v) for k, v in tf(val.gather(np.arange(5))).items()}
+    res, _ = rt.val(state, vb, jnp.ones((5,), bool))
+    assert np.isfinite(float(res[0]))
+
+
+def test_leaf_emnist_missing_test_split(tmp_path):
+    """A train split without its test split must fail loudly, not fall
+    back to synthetic validation data."""
+    from commefficient_tpu.data.fed_emnist import FedEMNIST
+
+    _write_leaf_femnist(str(tmp_path))
+    os.unlink(str(tmp_path / "test" / "all_data_0.json"))
+    with pytest.raises(FileNotFoundError, match="test split is missing"):
+        FedEMNIST(str(tmp_path))
+
+
 # ------------------------------------------------------------- ImageNet
 
 
