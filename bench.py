@@ -38,8 +38,15 @@ from bench_common import log, peak_flops, timed_rounds, with_retries
 NOMINAL_SINGLE_GPU_IMG_PER_SEC = 2000.0
 
 
-def run_cifar(result: dict) -> None:
-    """Fill ``result`` in place so partial progress survives a crash."""
+def run_cifar(result: dict, W: int = 8, B: int = 64,
+              n_rounds: int = 20) -> None:
+    """Fill ``result`` in place so partial progress survives a crash.
+
+    Default (W=8, B=64) is the flagship-parity round shape — 512
+    images/round, which a v5e finishes in ~0.5 ms of model time per
+    client: the round is BATCH-bound there (model isolated ~51% MFU, the
+    round ~17%). The saturating point below (B=512) exists to show the
+    framework's ceiling when the round actually feeds the chip."""
     import jax
     import jax.numpy as jnp
 
@@ -49,8 +56,6 @@ def run_cifar(result: dict) -> None:
     from commefficient_tpu.losses import make_cv_loss
 
     log("devices:", jax.devices())
-
-    W, B = 8, 64  # 8 simulated clients/round x 64 images
     cfg = FedConfig(
         mode="sketch", error_type="virtual", local_momentum=0.0,
         virtual_momentum=0.9, weight_decay=5e-4,
@@ -83,7 +88,6 @@ def run_cifar(result: dict) -> None:
     client_ids = jnp.arange(W, dtype=jnp.int32)
     lr = 0.1
 
-    n_rounds = 20
     dt, metrics = timed_rounds(runtime, (client_ids, batch, mask, lr),
                                warmup=2, rounds=n_rounds, desc="cifar")
 
@@ -96,8 +100,8 @@ def run_cifar(result: dict) -> None:
     result["value"] = round(ips, 1)
     result["vs_baseline"] = round(ips / NOMINAL_SINGLE_GPU_IMG_PER_SEC, 3)
 
-    # MFU numerator = MODEL FLOPs (the ResNet-9 fwd+bwd for the round's 512
-    # images, from XLA's cost analysis of the bare value_and_grad — no
+    # MFU numerator = MODEL FLOPs (the ResNet-9 fwd+bwd for the round's
+    # W*B images, from XLA's cost analysis of the bare value_and_grad — no
     # scans there, so the count is trustworthy), consistent with
     # bench_gpt2's analytic model-FLOPs definition. The sketch/server ops
     # the round also executes are real work but not "model FLOPs".
@@ -137,6 +141,23 @@ def main():
     # insurance: the measured headline lands in the stderr tail NOW, so a
     # kill/hang during the (long-compiling) GPT-2 stage cannot lose it
     log("headline:", json.dumps(result))
+    # second CIFAR point at a round size that FEEDS the chip (VERDICT r3
+    # item 4): same model/sketch config, 8 clients x 512 images. The
+    # flagship-parity headline above is deliberately batch-starved (its
+    # round shape matches the reference experiment, not the hardware);
+    # this point records what the same machinery does when the round is
+    # compute-bound.
+    try:
+        sat = {"metric": "cifar10_sketch_round_throughput_saturated",
+               "value": None, "unit": "images/sec", "vs_baseline": None,
+               "mfu": None, "round_images": 8 * 512}
+        run_cifar(sat, W=8, B=512, n_rounds=10)
+        result["cifar_saturated"] = sat
+        log("saturated:", json.dumps(sat))
+    except Exception as e:
+        log(traceback.format_exc())
+        log(f"WARNING: saturated CIFAR bench failed ({e})")
+        result["cifar_saturated"] = {"error": f"{type(e).__name__}: {e}"}
     # secondary metric: the GPT-2 (124M) sketched round, so the driver's
     # BENCH record captures both benchmarks (best-effort — the headline
     # CIFAR metric must survive a GPT-2 failure, e.g. an OOM on a small

@@ -164,8 +164,12 @@ class FedConfig:
     # diverges under error feedback; this flag exists to reproduce that
     # study, not to train with
     allow_divergent_rht: bool = False
-    # rht transform compute dtype ("float32" | "bfloat16"); bf16 halves the
-    # transform's HBM traffic at ~1e-3 relative estimate noise
+    # sketch wire/compute dtype ("float32" | "bfloat16"). For circ/hash:
+    # sketch-table UPLOADS and the multichip table psum travel in bf16 —
+    # half the ICI payload (the reference's NCCL-reduce quantity,
+    # fed_worker.py:138) at ~2^-8 relative cell rounding; server math
+    # stays fp32. For rht it additionally selects the transform compute
+    # dtype (halves the transform's HBM traffic).
     sketch_dtype: str = "float32"
     # rht row-at-a-time transforms (memory mode): -1 auto (on at dp >= 2^25),
     # 0 force batched, 1 force scanned. bf16 single-vector round-trips fit
@@ -197,6 +201,10 @@ class FedConfig:
     # (tokens, vocab) fp32 tensor (+ cotangent) — the GPT-2 microbatch-8
     # memory enabler (losses._chunked_lm_nll). 0 = dense
     lm_chunk: int = 0
+    # GPT-2 attention implementation: "dense" (materialized logits) or
+    # "flash" (fused TPU Pallas kernel, O(S) attention memory — pairs with
+    # --no-remat at flagship scale; falls back to dense off-TPU/unaligned S)
+    attn_impl: str = "dense"
 
     # filled in at model-build time, like the reference's args.grad_size
     # (fed_aggregator.py:88). Frozen dataclass => use `replace`.
@@ -207,6 +215,7 @@ class FedConfig:
         assert self.error_type in ERROR_TYPES, self.error_type
         assert self.dp_mode in DP_MODES, self.dp_mode
         assert self.pallas in ("auto", "on", "off"), self.pallas
+        assert self.attn_impl in ("dense", "flash"), self.attn_impl
         if self.mode == "fedavg":
             # reference invariants: utils.py:225-228
             assert self.local_batch_size == -1
@@ -375,6 +384,8 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--remat", action="store_true", dest="do_remat")
     p.add_argument("--remat_policy", type=str, default="")
     p.add_argument("--lm_chunk", type=int, default=0)
+    p.add_argument("--attn_impl", choices=("dense", "flash"),
+                   default="dense")
     return parser
 
 

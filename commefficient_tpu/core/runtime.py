@@ -158,6 +158,10 @@ class FedRuntime:
                 cfg.sketch_impl, cfg.grad_size, cfg.num_cols, cfg.num_rows,
                 cfg.num_blocks, seed=cfg.sketch_seed, dtype=cfg.sketch_dtype,
                 scan_rows=cfg.sketch_scan_rows, pallas=cfg.pallas)
+        # sketch-table wire dtype (--sketch_dtype): uploads/psum payloads
+        # travel rounded to this dtype; all server math stays fp32
+        self._table_dtype = (jnp.dtype(cfg.sketch_dtype)
+                             if cfg.mode == "sketch" else jnp.float32)
         # Sketch linearity: sum-of-client-sketches == sketch-of-summed-grads,
         # so the O(d·r) encode can run once per round instead of once per
         # client — unless a per-client nonlinearity (table clip) intervenes.
@@ -401,9 +405,26 @@ class FedRuntime:
                              0 if has_err else None, 0, None))(
                         used, batch, mask, vel_rows, err_rows,
                         client_rngs, cs)
-            agg = out.transmit.sum(axis=0)
+            # --sketch_dtype bfloat16: sketch-table UPLOADS travel in bf16
+            # (the reference's NCCL-reduce payload halved,
+            # fed_worker.py:138). Quantization points, matched between one
+            # chip and a mesh (up to the psum's partial-sum rounding
+            # order): per-client tables are rounded before the
+            # server's accumulation (non-deferred encode only — deferred
+            # encode has no per-client table), and the cross-device SUM is
+            # rounded once — by the bf16 psum on a mesh, explicitly here
+            # on a single device.
+            td = self._table_dtype
+            tx = out.transmit
+            wire = (td != jnp.float32 and not self._dense_preimage
+                    and cfg.mode == "sketch")
+            if wire and not self._defer_encode and tx.ndim == 3:
+                tx = tx.astype(td).astype(jnp.float32)
+            agg = tx.sum(axis=0)
             if self._defer_encode and not self._dense_preimage:
                 agg = cs.encode(agg)
+            if wire and self._axis is None and agg.ndim == 2:
+                agg = agg.astype(td).astype(jnp.float32)
             n_total = out.n_valid.sum()
             if self._axis is not None:
                 # the aggregation spans every mesh axis: clients sum across
@@ -422,8 +443,20 @@ class FedRuntime:
                         all_axes, scatter_dimension=0, tiled=True)
                 else:
                     # sketch tables are already the compressed payload: one
-                    # table-sized psum (analogue of encode-before-NCCL)
-                    agg = lax.psum(agg, all_axes)
+                    # table-sized psum (analogue of encode-before-NCCL);
+                    # --sketch_dtype bfloat16 halves this payload — the
+                    # multichip bandwidth lever (accumulation inside the
+                    # collective is then bf16 too; measured impact in
+                    # tests/test_parallel.py + README)
+                    if td != jnp.float32 and agg.ndim == 2:
+                        # the barrier pins the collective's payload dtype:
+                        # without it XLA hoists the f32 convert back
+                        # through the all-reduce and the wire stays f32
+                        agg = lax.optimization_barrier(
+                            lax.psum(agg.astype(td), all_axes))
+                        agg = agg.astype(jnp.float32)
+                    else:
+                        agg = lax.psum(agg, all_axes)
                 if self._seq_axis is not None:
                     # shard_map autodiff with vma checking off transposes
                     # psum to psum, so each seq shard's gradient comes out

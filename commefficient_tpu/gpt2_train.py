@@ -36,6 +36,7 @@ from commefficient_tpu.models.gpt2 import (
     GPT2Config,
     GPT2DoubleHeads,
     load_hf_weights,
+    resolve_attn,
 )
 from commefficient_tpu.utils import TableLogger, TSVLogger, Timer
 
@@ -58,7 +59,7 @@ def build_gpt2(cfg: FedConfig, tokenizer):
                           compute_dtype=jnp.dtype(cfg.compute_dtype),
                           remat=cfg.do_remat,
                           remat_policy=cfg.remat_policy)
-    return GPT2DoubleHeads(gcfg), gcfg
+    return GPT2DoubleHeads(gcfg, attn_impl=resolve_attn(cfg.attn_impl)), gcfg
 
 
 def make_gpt2_schedule(cfg: FedConfig):

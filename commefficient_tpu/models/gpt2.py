@@ -82,6 +82,53 @@ def dense_causal_attention(q, k, v, dropout_rng=None):
     return jnp.einsum("...hqk,...khd->...qhd", probs, v)
 
 
+def flash_causal_attention(q, k, v, dropout_rng=None):
+    """Fused-softmax causal attention via the TPU Pallas flash kernel
+    (jax.experimental.pallas.ops.tpu.flash_attention): never materializes
+    the (H, S, S) logits tensor, so attention activation memory drops from
+    O(S^2) to O(S) — which is what lets the flagship GPT-2 round turn
+    block remat OFF (the logits tensors were the microbatch-8 memory
+    wall) and skip the ~33% backward recompute. Falls back to the dense
+    path off-TPU and for sequence lengths the kernel's lane tiling cannot
+    cover (S % 128 != 0)."""
+    S, D = q.shape[-3], q.shape[-1]
+    if jax.default_backend() != "tpu" or S % 128:
+        return dense_causal_attention(q, k, v)
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention)
+    lead = q.shape[:-3]
+    H = q.shape[-2]
+
+    def to4(t):  # (..., S, H, D) -> (B, H, S, D)
+        return jnp.moveaxis(t.reshape((-1,) + t.shape[-3:]), -2, 1)
+
+    # the kernel requires its block sizes to DIVIDE S; S % 128 == 0 is
+    # guaranteed above, so the largest dividing power-of-two block <= 512
+    # always exists (512 itself need not divide e.g. S=640)
+    blk = max(b for b in (512, 256, 128) if S % b == 0)
+    sizes = BlockSizes(block_q=blk, block_k_major=blk, block_k=blk,
+                       block_b=1, block_q_major_dkv=blk,
+                       block_k_major_dkv=blk, block_k_dkv=blk,
+                       block_q_dkv=blk, block_k_major_dq=blk,
+                       block_k_dq=blk, block_q_dq=blk)
+    out = flash_attention(to4(q), to4(k), to4(v), causal=True,
+                          sm_scale=1.0 / math.sqrt(D), block_sizes=sizes)
+    return jnp.moveaxis(out, 1, -2).reshape(lead + (S, H, D))
+
+
+ATTN_IMPLS = {"dense": dense_causal_attention,
+              "flash": flash_causal_attention}
+
+
+def resolve_attn(name: str) -> Callable:
+    """Config-string -> attention callable (config.py --attn_impl)."""
+    try:
+        return ATTN_IMPLS[name]
+    except KeyError:
+        raise ValueError(f"unknown attn_impl {name!r}: "
+                         f"want one of {sorted(ATTN_IMPLS)}") from None
+
+
 class Block(nn.Module):
     cfg: GPT2Config
     attn_impl: Callable = dense_causal_attention

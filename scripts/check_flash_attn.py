@@ -1,0 +1,21 @@
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np, time
+from commefficient_tpu.models.gpt2 import (dense_causal_attention,
+                                           flash_causal_attention)
+rng = np.random.RandomState(0)
+for shape in [(2, 256, 12, 64), (2, 2, 256, 12, 64)]:
+    q, k, v = (jnp.asarray(rng.randn(*shape), jnp.bfloat16) for _ in range(3))
+    d = jax.jit(dense_causal_attention)(q, k, v)
+    f = jax.jit(flash_causal_attention)(q, k, v)
+    err = float(jnp.max(jnp.abs(d.astype(jnp.float32) - f.astype(jnp.float32))))
+    print(shape, "fwd max err", err)
+    # grad parity through a scalar loss
+    def loss(fn, q, k, v):
+        return (fn(q, k, v).astype(jnp.float32) ** 2).mean()
+    gd = jax.jit(jax.grad(lambda q: loss(dense_causal_attention, q, k, v)))(q)
+    gf = jax.jit(jax.grad(lambda q: loss(flash_causal_attention, q, k, v)))(q)
+    gerr = float(jnp.max(jnp.abs(gd.astype(jnp.float32) - gf.astype(jnp.float32))))
+    gscale = float(jnp.max(jnp.abs(gd.astype(jnp.float32))))
+    print(shape, "grad max err", gerr, "grad scale", gscale)
+print("FLASH PARITY OK")

@@ -302,6 +302,24 @@ def test_imagenet_tree_ingest(tmp_path):
     assert len(val) == 2
 
 
+# ----------------------------------------------------------- multi-host
+
+
+@pytest.mark.slow
+def test_multihost_two_process_round():
+    """scripts/multihost_dryrun.py: two real jax.distributed processes
+    execute one sharded federated round over a global 8-device mesh and
+    match the single-process golden checksum (PARITY §2.8 multi-host
+    claim, executed — VERDICT r3 item 7)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts",
+                                      "multihost_dryrun.py")],
+        cwd=repo, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+    assert "2-process round == single-process round" in out.stdout
+
+
 @pytest.mark.slow
 def test_imagenet_recipe_smoke(tmp_path):
     """scripts/imagenet.sh --test: the FixupResNet50 recipe executes one

@@ -187,6 +187,61 @@ def test_collectives_are_shard_or_table_sized(mode, extra):
         assert any(k == "all-to-all" for k, _ in colls), colls
 
 
+def test_bf16_sketch_tables():
+    """--sketch_dtype bfloat16 (VERDICT r3 item 6): the table psum payload
+    must compile as a bf16 all-reduce (half the ICI bytes of the
+    reference's NCCL reduce, fed_worker.py:138), the round must stay
+    close to the fp32-wire round (the only difference is ~2^-8 relative
+    cell rounding), and single-device vs mesh must agree — the one-chip
+    emulation applies the same wire quantization the psum would."""
+    import re
+
+    extra = dict(mode="sketch", error_type="virtual", k=5, num_rows=3,
+                 num_cols=32, num_blocks=2, track_bytes=False)
+    params = {"w": jnp.asarray(
+        np.random.RandomState(0).randn(6, 3), jnp.float32)}
+    mesh = make_mesh((8,), ("clients",))
+    batch, mask, cids = make_batch(1)
+
+    rt16 = FedRuntime(make_cfg(sketch_dtype="bfloat16", **extra), params,
+                      quad_loss, num_clients=16, mesh=mesh)
+    # payload dtype pinned in the UNOPTIMIZED lowering: the program hands
+    # the collective a bf16 table. (The compiled text cannot be asserted
+    # on the CPU backend — its FloatSupport pass legally promotes bf16
+    # all-reduces to f32 because CPU lacks bf16 arithmetic; TPU keeps the
+    # native bf16 wire.)
+    txt = rt16._round.lower(
+        rt16.init_state(), cids, batch, mask,
+        jnp.asarray(0.1, jnp.float32), rt16.cs).as_text()
+    assert re.search(
+        r"stablehlo\.all_reduce.*?"
+        r"\(tensor<3x32xbf16>\) -> tensor<3x32xbf16>", txt, re.S), \
+        "expected a bf16 table-sized all_reduce in the lowering"
+
+    # numerics: bf16 wire stays near the fp32 wire...
+    rt32 = FedRuntime(make_cfg(**extra), params, quad_loss,
+                      num_clients=16, mesh=mesh)
+    s16, s32 = rt16.init_state(), rt32.init_state()
+    for _ in range(3):
+        s16, _ = rt16.round(s16, cids, batch, mask, 0.1)
+        s32, _ = rt32.round(s32, cids, batch, mask, 0.1)
+    assert np.all(np.isfinite(np.asarray(s16.ps_weights)))
+    np.testing.assert_allclose(np.asarray(s16.ps_weights),
+                               np.asarray(s32.ps_weights),
+                               rtol=0.05, atol=1e-3)
+    # ...and the single-device emulation matches the mesh wire closely
+    # (identical quantization points up to reduction order)
+    rt1 = FedRuntime(make_cfg(sketch_dtype="bfloat16", **extra), params,
+                     quad_loss, num_clients=16)
+    s1 = rt1.init_state()
+    for _ in range(3):
+        s1, _ = rt1.round(s1, cids, batch, mask, 0.1)
+    d = rt1.cfg.grad_size
+    np.testing.assert_allclose(np.asarray(s1.ps_weights),
+                               np.asarray(s16.ps_weights[:d]),
+                               rtol=0.02, atol=1e-3)
+
+
 def test_sharded_val_matches_dense():
     """Mesh-parallel validation (VERDICT r2 item 6): the val batch shards
     over all devices and the weighted recombination must equal the dense
