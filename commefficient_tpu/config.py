@@ -205,6 +205,14 @@ class FedConfig:
     # "flash" (fused TPU Pallas kernel, O(S) attention memory — pairs with
     # --no-remat at flagship scale; falls back to dense off-TPU/unaligned S)
     attn_impl: str = "dense"
+    # jointly-computed round gradient (core/client.py make_fused_grad):
+    # when no per-client nonlinearity exists, accumulate the round's
+    # aggregate into ONE (d,) buffer instead of vmap's per-client (W, d)
+    # gradient. Exact up to summation order; measured ~15% off the
+    # flagship GPT-2 round. Auto-disabled when ineligible (local state,
+    # clip, DP, topk_down, fedavg/local_topk, seq sharding, straddling
+    # microbatches); this flag forces the vmap path everywhere.
+    fused_clients: bool = True
 
     # filled in at model-build time, like the reference's args.grad_size
     # (fed_aggregator.py:88). Frozen dataclass => use `replace`.
@@ -386,6 +394,8 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--lm_chunk", type=int, default=0)
     p.add_argument("--attn_impl", choices=("dense", "flash"),
                    default="dense")
+    p.add_argument("--no_fused_clients", dest="fused_clients",
+                   action="store_false", default=True)
     return parser
 
 

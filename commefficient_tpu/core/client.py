@@ -146,6 +146,78 @@ def make_forward_grad(
     return fwd
 
 
+def make_fused_grad(
+    cfg: FedConfig,
+    loss_fn: Callable,
+    unravel: Callable[[jax.Array], Any],
+    batch_size: int,
+):
+    """Jointly-computed round gradient: one microbatch scan over ALL of the
+    round's clients instead of ``vmap(per-client scan)``.
+
+    The aggregation the server consumes is ``sum_c n_c * g_c`` where
+    ``g_c = sum_mb grad(mean loss of mb) + wd-term`` (fed_worker.py:190 +
+    fed_aggregator.py:332 weighting). When no per-client nonlinearity
+    intervenes (no local momentum/error rows, no per-client clip/DP/table
+    op — ``FedRuntime._fused`` checks), that sum is linear in the
+    per-microbatch gradients, so it can be accumulated into ONE (d,)
+    buffer with each microbatch's gradient weighted by its client's datum
+    count. The vmapped path instead materializes a per-client (W, d)
+    gradient (2.9 GB at GPT-2 92M x 8 clients) and, inside the backward,
+    W separate embedding-gradient accumulators — the profiler measured
+    ~67 ms/round of the flagship GPT-2 round in exactly those per-client
+    wte-gradient buffers (runs/profile_gpt2/BREAKDOWN.md).
+
+    Exactness relies on microbatches never straddling clients: requires
+    ``batch_size % microbatch == 0`` (checked by the runtime's
+    eligibility predicate). Per-client results/n_valid keep their (W,)
+    shapes — each microbatch's owning client index rides the scan xs.
+    """
+    num_iters, mb = _num_microbatches(cfg, batch_size)
+    assert num_iters * mb == batch_size, (num_iters, mb, batch_size)
+
+    def loss_on_vec(vec, mb_batch, mb_mask):
+        return loss_fn(unravel(vec), mb_batch, mb_mask)
+
+    grad_fn = jax.value_and_grad(loss_on_vec, has_aux=True)
+
+    def fused(params_vec, batch, mask):
+        W = mask.shape[0]
+        maskf = mask.astype(jnp.float32)
+        n_per_client = maskf.sum(axis=1)                     # (W,)
+        flat = jax.tree.map(
+            lambda t: t.reshape((W * num_iters, mb) + t.shape[2:]), batch)
+        flat_mask = maskf.reshape(W * num_iters, mb)
+        n_res = cfg.num_results_train
+
+        client_of_mb = jnp.repeat(jnp.arange(W), num_iters)
+        nc_of_mb = jnp.repeat(n_per_client, num_iters)
+
+        def body(carry, inp):
+            g_acc, sums = carry
+            mb_batch, mb_mask, c, nc = inp
+            (loss, metrics), g = grad_fn(params_vec, mb_batch, mb_mask)
+            w = mb_mask.sum()
+            g_acc = g_acc + g * nc
+            sums = sums.at[:, c].add(
+                jnp.stack((loss,) + tuple(metrics)) * w)
+            return (g_acc, sums), None
+
+        init = (jnp.zeros_like(params_vec), jnp.zeros((n_res, W)))
+        (g, sums), _ = lax.scan(
+            body, init, (flat, flat_mask, client_of_mb, nc_of_mb))
+        # decoupled weight decay, summed over the round's clients (equal to
+        # the per-client term (wd/W)*w scaled by n_c and summed)
+        if cfg.weight_decay != 0:
+            g = g + ((cfg.weight_decay / cfg.num_workers)
+                     * n_per_client.sum()) * params_vec
+        denom = jnp.maximum(n_per_client, 1.0)
+        results = tuple(sums[j] / denom for j in range(n_res))
+        return g, results, n_per_client
+
+    return fused
+
+
 def make_client_step(
     cfg: FedConfig,
     loss_fn: Callable,

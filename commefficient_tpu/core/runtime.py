@@ -190,9 +190,30 @@ class FedRuntime:
                                 and getattr(self.cs, "dense_transform", False))
 
         loss_fn_val = loss_fn_val if loss_fn_val is not None else loss_fn_train
+        # Fused client gradients: when nothing nonlinear happens per client
+        # (no local momentum/error rows, no per-client clip/table-op/DP
+        # noise, no per-client weights, no seq sharding), the round's
+        # aggregate sum_c n_c*g_c is linear in the microbatch gradients and
+        # can be computed by ONE scan into ONE (d,) buffer instead of
+        # vmap's per-client (W, d) gradient — see make_fused_grad. Exact
+        # (up to summation order); --no_fused_clients forces the vmap path.
+        n_iters, mb = client_lib._num_microbatches(cfg, self.batch_size)
+        self._fused = (
+            cfg.fused_clients
+            and cfg.mode in ("sketch", "true_topk", "uncompressed")
+            and cfg.local_momentum == 0 and cfg.error_type != "local"
+            and not cfg.do_dp and cfg.max_grad_norm is None
+            and not cfg.do_topk_down
+            and self._seq_axis is None
+            and n_iters * mb == self.batch_size)
+        self._fused_fn = None
         if cfg.mode == "fedavg":
             self._client_fn = client_lib.make_fedavg_client(
                 cfg, loss_fn_train, unravel, self.batch_size)
+        elif self._fused:
+            self._fused_fn = client_lib.make_fused_grad(
+                cfg, loss_fn_train, unravel, self.batch_size)
+            self._client_fn = None
         else:
             self._client_fn = client_lib.make_client_step(
                 cfg, loss_fn_train, unravel, self.batch_size,
@@ -388,6 +409,15 @@ class FedRuntime:
                 used = used_weights[: cfg.grad_size]
             else:
                 used = used_weights
+            # --sketch_dtype bfloat16 wire (see config.py): per-client
+            # table uploads round to bf16 before the server's accumulation
+            # (non-deferred encode only — deferred encode has no
+            # per-client table), and the cross-device SUM rounds once — by
+            # the bf16 psum on a mesh, explicitly here on a single device
+            # (quantization points matched up to psum partial-sum order).
+            td = self._table_dtype
+            wire = (td != jnp.float32 and not self._dense_preimage
+                    and cfg.mode == "sketch")
             if cfg.mode == "fedavg":
                 # fedavg applies the LR on the CLIENT against true-d
                 # weights; a per-param vector arrives mesh-padded for the
@@ -397,6 +427,14 @@ class FedRuntime:
                     self._client_fn,
                     in_axes=(params_axis, 0, 0, None, 0))(
                         used, batch, mask, lr_c, client_rngs)
+                agg = out.transmit.sum(axis=0)
+            elif self._fused:
+                # jointly-computed round gradient (make_fused_grad): ONE
+                # (d,) accumulator over all local clients' microbatches —
+                # no per-client (W, d) gradient materialization
+                agg, f_results, f_nvalid = self._fused_fn(used, batch, mask)
+                out = client_lib.ClientOut(None, None, None, f_results,
+                                           f_nvalid)
             else:
                 out = jax.vmap(
                     self._client_fn,
@@ -405,22 +443,10 @@ class FedRuntime:
                              0 if has_err else None, 0, None))(
                         used, batch, mask, vel_rows, err_rows,
                         client_rngs, cs)
-            # --sketch_dtype bfloat16: sketch-table UPLOADS travel in bf16
-            # (the reference's NCCL-reduce payload halved,
-            # fed_worker.py:138). Quantization points, matched between one
-            # chip and a mesh (up to the psum's partial-sum rounding
-            # order): per-client tables are rounded before the
-            # server's accumulation (non-deferred encode only — deferred
-            # encode has no per-client table), and the cross-device SUM is
-            # rounded once — by the bf16 psum on a mesh, explicitly here
-            # on a single device.
-            td = self._table_dtype
-            tx = out.transmit
-            wire = (td != jnp.float32 and not self._dense_preimage
-                    and cfg.mode == "sketch")
-            if wire and not self._defer_encode and tx.ndim == 3:
-                tx = tx.astype(td).astype(jnp.float32)
-            agg = tx.sum(axis=0)
+                tx = out.transmit
+                if wire and not self._defer_encode and tx.ndim == 3:
+                    tx = tx.astype(td).astype(jnp.float32)
+                agg = tx.sum(axis=0)
             if self._defer_encode and not self._dense_preimage:
                 agg = cs.encode(agg)
             if wire and self._axis is None and agg.ndim == 2:

@@ -33,18 +33,23 @@ from commefficient_tpu.data.fed_dataset import FedDataset
 _SYNTH_PROTOS = "shared-v2"
 
 # hard-regime knobs (see _synthetic_cifar hard=True), calibrated by TPU
-# sweeps so a 24-epoch ResNet-9 run lands well below 100% val accuracy
-# and is still climbing. The class evidence is SPARSE (a _HARD_FRAC
-# subset of pixels carries a strong ±_HARD_DELTA offset): gradients then
-# have heavy hitters, the structure FetchSGD-style top-k/sketch methods
-# target. (A first, uniform-evidence design — every pixel carrying a
-# faint delta — was measured top-k-ADVERSARIAL: uncompressed reached 95%
-# while sketch/top-k stalled at ~20%, because no coordinate mattered
-# more than any other and only k/d of a uniformly-informative gradient
+# sweeps so a 24-epoch run lands below 100% val accuracy EVEN
+# UNCOMPRESSED (round-4 calibration: uncompressed ResNet-9 reaches
+# 90.9% at epoch 24 — a nontrivial ceiling near the reference
+# lineage's 94% real-CIFAR target, so compression gaps are measured
+# against real headroom; the round-3 constants 0.15/60/70 let
+# uncompressed and true_topk saturate at 100% by epochs 11/13). The
+# class evidence is SPARSE (a _HARD_FRAC subset of pixels carries a
+# strong ±_HARD_DELTA offset): gradients then have heavy hitters, the
+# structure FetchSGD-style top-k/sketch methods target. (A first,
+# uniform-evidence design — every pixel carrying a faint delta — was
+# measured top-k-ADVERSARIAL: uncompressed reached 95% while
+# sketch/top-k stalled at ~20%, because no coordinate mattered more
+# than any other and only k/d of a uniformly-informative gradient
 # survives sparsification.)
-_HARD_FRAC = 0.15
-_HARD_DELTA = 60
-_HARD_NOISE = 70
+_HARD_FRAC = 0.10
+_HARD_DELTA = 45
+_HARD_NOISE = 85
 
 
 def _synthetic_cifar(num_classes: int, per_class: int, img_hw: int = 32,

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Calibrate the CIFAR hard-regime knobs (VERDICT r3 weak #4): find
+(_HARD_FRAC, _HARD_DELTA, _HARD_NOISE) where even UNCOMPRESSED training
+lands below 100% val accuracy at epoch 24 — so the three-way comparison
+measures compression cost against a nontrivial ceiling instead of a
+saturated one. Monkeypatches the knobs (the synth marker carries them,
+so each setting re-prepares its own arrays) and runs the runs/README.md
+recipe's uncompressed arm.
+
+Usage: python scripts/calibrate_hard.py "frac,delta,noise" [...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_setting(frac: float, delta: int, noise: int, mode: str,
+                out_dir: str, epochs: int = 24):
+    from commefficient_tpu import cv_train
+    from commefficient_tpu.data import fed_cifar
+
+    fed_cifar._HARD_FRAC = frac
+    fed_cifar._HARD_DELTA = delta
+    fed_cifar._HARD_NOISE = noise
+    os.makedirs(out_dir, exist_ok=True)
+    argv = ["--dataset_name", "CIFAR10", "--model", "ResNet9",
+            "--batchnorm", "--iid", "--num_clients", "40",
+            "--num_workers", "8", "--local_batch_size", "64",
+            "--num_epochs", str(epochs), "--synthetic_per_class", "400",
+            "--synthetic_hard", "--synthetic_label_noise", "0.08",
+            "--lr_scale", "0.1", "--seed", "21",
+            "--local_momentum", "0.0", "--virtual_momentum", "0.9",
+            "--dataset_dir", out_dir]
+    if mode == "sketch":
+        argv += ["--mode", "sketch", "--error_type", "virtual",
+                 "--k", "50000", "--num_rows", "5", "--num_cols", "500000",
+                 "--num_blocks", "20", "--approx_topk"]
+    elif mode == "true_topk":
+        argv += ["--mode", "true_topk", "--error_type", "virtual",
+                 "--k", "50000", "--approx_topk"]
+    else:
+        argv += ["--mode", "uncompressed", "--error_type", "none"]
+    print(f"=== frac={frac} delta={delta} noise={noise} mode={mode}",
+          flush=True)
+    summary = cv_train.main(argv)
+    print(f"=== RESULT frac={frac} delta={delta} noise={noise} "
+          f"mode={mode}: "
+          + (f"val acc {summary['test_acc']:.4f}" if summary else "DIVERGED"),
+          flush=True)
+    return summary
+
+
+def main():
+    for spec in sys.argv[1:]:
+        frac, delta, noise = (float(x) for x in spec.split(","))
+        run_setting(frac, int(delta), int(noise), "uncompressed",
+                    f"/tmp/hardcal_{spec.replace(',', '_').replace('.', '')}")
+
+
+if __name__ == "__main__":
+    main()

@@ -68,7 +68,10 @@ def build_round():
 def parse_xplane(outdir: str):
     """Aggregate device-side op durations from the newest xplane.pb.
     Returns [(name, total_ms)] sorted descending, plus the wall span."""
-    from tensorflow.core.profiler.protobuf import xplane_pb2
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError:   # older TF ships it under tensorflow.core
+        from tensorflow.core.profiler.protobuf import xplane_pb2
 
     files = sorted(glob.glob(os.path.join(
         outdir, "plugins/profile/*/*.xplane.pb")), key=os.path.getmtime)

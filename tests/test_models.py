@@ -97,3 +97,26 @@ def test_model_grads_flow():
     g = jax.grad(loss)(params)
     norms = [float(jnp.linalg.norm(t)) for t in jax.tree.leaves(g)]
     assert any(n > 0 for n in norms)
+
+
+def test_attn_impl_resolver_and_cpu_fallback():
+    """--attn_impl plumbing: resolve_attn maps names to callables and
+    rejects unknowns; off-TPU (this CPU suite) flash_causal_attention
+    must fall back to the dense path bit-exactly (the kernel itself is
+    parity-checked on hardware by scripts/check_flash_attn.py)."""
+    import pytest
+
+    from commefficient_tpu.models.gpt2 import (ATTN_IMPLS,
+                                               dense_causal_attention,
+                                               flash_causal_attention,
+                                               resolve_attn)
+
+    assert resolve_attn("dense") is dense_causal_attention
+    assert resolve_attn("flash") is flash_causal_attention
+    with pytest.raises(ValueError, match="unknown attn_impl"):
+        resolve_attn("paged")
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 128, 4, 16))
+    d = dense_causal_attention(q, q, q)
+    f = flash_causal_attention(q, q, q)   # CPU => dense fallback
+    np.testing.assert_array_equal(np.asarray(d), np.asarray(f))
+    assert sorted(ATTN_IMPLS) == ["dense", "flash"]
