@@ -59,8 +59,14 @@ def gpt2_model_flops(gcfg, tokens: int, S: int) -> float:
     return 3.0 * fwd_per_tok * tokens
 
 
-def run() -> dict:
-    """Build, warm up and time the GPT-2 round; returns the result dict."""
+def run(remat: bool = True) -> dict:
+    """Build, warm up and time the GPT-2 round; returns the result dict.
+
+    ``remat=True`` is the shipping configuration. remat=False spends the
+    HBM the fused-clients path freed on saved activations instead of
+    backward recompute — measured SLOWER (69.3k vs 76.5k tok/s pre-pallas
+    -encode: the saved-activation HBM traffic costs more than the
+    recompute FLOPs); kept parameterized so the trade stays measurable."""
     import jax
     import jax.numpy as jnp
 
@@ -70,7 +76,7 @@ def run() -> dict:
     from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
 
     log("devices:", jax.devices())
-    gcfg = GPT2Config(remat=True)
+    gcfg = GPT2Config(remat=remat)
     model = GPT2DoubleHeads(gcfg)
     W, B, NC, S = 8, 8, 2, 256
     rng = np.random.RandomState(0)

@@ -86,9 +86,9 @@ class CirculantSketch:
     c: int
     r: int
     num_blocks: int                 # decode memory chunking over the m axis
-    # pallas kernel policy (config.py --pallas): "auto" = fused decode when
-    # eligible (the measured win), "on" = also the pallas encode (measured
-    # ~equal to the XLA static-roll encode), "off" = XLA paths only
+    # pallas kernel policy (config.py --pallas): "auto"/"on" = fused
+    # encode AND decode when eligible (both measured wins under the
+    # fused-clients round), "off" = XLA paths only
     pallas: str = "auto"
 
     dense_transform = False
@@ -175,11 +175,14 @@ class CirculantSketch:
         return self._pallas_eligible()
 
     def _use_pallas_encode(self) -> bool:
-        # the static-roll XLA encode is already ~26 ms (the shifts are
-        # trace-time constants, compiled to fixed slices); the pallas
-        # encode re-reads the input nct times and lands ~equal, so it
-        # stays opt-in (--pallas on)
-        return self.pallas == "on" and self._pallas_eligible()
+        # ON when eligible (round 4): with the fused-clients round (ONE
+        # encode of the summed gradient per round), the pallas encode
+        # measured 429 -> 385 ms on the flagship GPT-2 round (76.5k ->
+        # 85.2k tok/s) vs the XLA static-roll path. (Under the old
+        # per-client vmap encode the two were ~equal, which is why this
+        # began opt-in.) Kept as a separate seam from decode in case the
+        # two policies ever diverge again.
+        return self._pallas_eligible()
 
     def encode(self, vec: jax.Array) -> jax.Array:
         assert vec.ndim == 1 and vec.shape[0] == self.d, (vec.shape, self.d)

@@ -28,15 +28,15 @@ Design history (all numbers measured the same way):
 
 Exactness vs the roll path is asserted in interpret mode by
 tests/test_ops.py and against numpy on the TPU at flagship scale.
-Used AUTOMATICALLY for decode on TPU when the sketch's shifts are
-1024-aligned and the wrap-padded table fits the VMEM residency budget;
-encode keeps the static-roll XLA path by default (26 ms — the rolls are
-trace-time constants there, which XLA compiles to fixed slices; the
-pallas encode re-reads the input nct times and lands at ~the same
-cost). The ``--pallas`` config flag controls the policy: ``off``
-disables, ``on`` also forces the pallas encode, ``auto`` (default) is
-decode-only. Replaces the external CUDA CSVec hot path (reference
-fed_worker.py:312-320).
+Used AUTOMATICALLY for BOTH encode and decode on TPU when the sketch's
+shifts are 1024-aligned and the wrap-padded table fits the VMEM
+residency budget. (History: encode began opt-in — under the per-client
+vmap round it measured ~equal to the XLA static-roll path; the round-4
+fused-clients round encodes the summed gradient ONCE, where the pallas
+encode lifts the flagship GPT-2 round 76.5k -> 85.2k tok/s.) The
+``--pallas`` config flag controls the policy: ``off`` disables, ``auto``
+(default) and ``on`` enable when eligible. Replaces the external CUDA
+CSVec hot path (reference fed_worker.py:312-320).
 """
 
 from __future__ import annotations

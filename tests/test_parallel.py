@@ -195,6 +195,11 @@ def test_collectives_are_shard_or_table_sized(mode, extra):
     # microbatched: 2 microbatches per client — the fused scan must keep
     # per-client results/weighting exact across the client boundary
     ("uncompressed", {"microbatch_size": 2}),
+    # bf16 wire: the fused branch's sum-rounding points must agree with
+    # the vmap branch's (deferred encode in both)
+    ("sketch", {"error_type": "virtual", "k": 5, "num_rows": 3,
+                "num_cols": 32, "num_blocks": 2,
+                "sketch_dtype": "bfloat16"}),
 ])
 def test_fused_clients_matches_vmap(mode, extra):
     """The jointly-computed round gradient (make_fused_grad, default-on)
@@ -229,11 +234,16 @@ def test_fused_clients_matches_vmap(mode, extra):
     for _ in range(3):
         sm, mm = rt_m.round(sm, cids, batch, mask, 0.1)
     d = rt_f.cfg.grad_size
+    # a bf16 WIRE rounds the mesh psum's partial sums where one chip
+    # rounds the full sum once — agreement there is only to bf16 epsilon
+    wide = extra.get("sketch_dtype") == "bfloat16"
     np.testing.assert_allclose(np.asarray(sf.ps_weights),
                                np.asarray(sm.ps_weights[:d]),
-                               rtol=1e-4, atol=1e-6)
+                               rtol=0.02 if wide else 1e-4,
+                               atol=1e-3 if wide else 1e-6)
     np.testing.assert_allclose(np.asarray(mf["results"][0]),
-                               np.asarray(mm["results"][0]), rtol=1e-5)
+                               np.asarray(mm["results"][0]),
+                               rtol=5e-3 if wide else 1e-5)
 
 
 def test_bf16_sketch_tables():
