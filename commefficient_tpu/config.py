@@ -202,10 +202,25 @@ class FedConfig:
     # (tokens, vocab) fp32 tensor (+ cotangent) — the GPT-2 microbatch-8
     # memory enabler (losses._chunked_lm_nll). 0 = dense
     lm_chunk: int = 0
-    # GPT-2 attention implementation: "dense" (materialized logits) or
-    # "flash" (fused TPU Pallas kernel, O(S) attention memory — pairs with
-    # --no-remat at flagship scale; falls back to dense off-TPU/unaligned S)
-    attn_impl: str = "dense"
+    # GPT-2 attention implementation: "auto" (default — dense below
+    # S=1024, flash above, the measured crossover on v5e:
+    # scripts/bench_longctx.py), "dense" (materialized logits), "flash"
+    # (fused TPU Pallas kernel, O(S) attention memory; falls back to
+    # dense off-TPU/unaligned S)
+    attn_impl: str = "auto"
+    # sketch-mode worker-gradient clipping (TPU-native extension): apply
+    # --max_grad_norm to the DENSE per-client gradient before encoding
+    # (threshold x num_iters, same semantics as the dense modes) instead
+    # of the reference's post-encode table clip (fed_worker.py:318-319 —
+    # a bare-threshold, semantically different operation). Measured
+    # finding (runs/gpt2_conv/README.md): on the from-scratch GPT-2
+    # corpus BOTH clip placements interact pathologically with
+    # table-space error feedback (1.74 -> 2.40 nll) even though the same
+    # clip rescues the dense modes — prefer unclipped sketch there; the
+    # flag exists to reproduce and study that interaction. Disables the
+    # fused-clients fast path (the clip is per-client); deferred encode
+    # survives (clipped dense gradients still sum before one encode).
+    sketch_dense_clip: bool = False
     # jointly-computed round gradient (core/client.py make_fused_grad):
     # when no per-client nonlinearity exists, accumulate the round's
     # aggregate into ONE (d,) buffer instead of vmap's per-client (W, d)
@@ -224,7 +239,7 @@ class FedConfig:
         assert self.error_type in ERROR_TYPES, self.error_type
         assert self.dp_mode in DP_MODES, self.dp_mode
         assert self.pallas in ("auto", "on", "off"), self.pallas
-        assert self.attn_impl in ("dense", "flash"), self.attn_impl
+        assert self.attn_impl in ("auto", "dense", "flash"), self.attn_impl
         if self.mode == "fedavg":
             # reference invariants: utils.py:225-228
             assert self.local_batch_size == -1
@@ -392,10 +407,16 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--remat", action="store_true", dest="do_remat")
     p.add_argument("--remat_policy", type=str, default="")
     p.add_argument("--lm_chunk", type=int, default=0)
-    p.add_argument("--attn_impl", choices=("dense", "flash"),
-                   default="dense")
+    p.add_argument("--attn_impl", choices=("auto", "dense", "flash"),
+                   default="auto",
+                   help="GPT-2 attention: auto = dense below S=1024, "
+                        "flash above (measured crossover)")
     p.add_argument("--no_fused_clients", dest="fused_clients",
                    action="store_false", default=True)
+    p.add_argument("--sketch_dense_clip", action="store_true",
+                   help="clip the dense worker gradient before sketch "
+                        "encode (threshold x num_iters) instead of the "
+                        "reference's post-encode table clip")
     return parser
 
 

@@ -119,8 +119,13 @@ def make_forward_grad(
         # threshold scales with the number of accumulation steps). Not
         # available seq-sharded (the runtime forbids it): the clip needs the
         # norm of the SUMMED client gradient, which per-shard norms cannot
-        # provide (partials are not orthogonal).
-        if cfg.max_grad_norm is not None and cfg.mode != "sketch":
+        # provide (partials are not orthogonal). --sketch_dense_clip
+        # extends the same PRE-encode clip to sketch mode (the reference
+        # can only clip the post-encode table, fed_worker.py:318-319 — an
+        # 8x-tighter, semantically different operation; measured
+        # consequences in runs/gpt2_conv/README.md).
+        if cfg.max_grad_norm is not None and (
+                cfg.mode != "sketch" or cfg.sketch_dense_clip):
             g = clip_by_l2_norm(g, cfg.max_grad_norm * num_iters)
         # differential privacy (reference fed_worker.py:304-309)
         if cfg.do_dp:
@@ -138,7 +143,8 @@ def make_forward_grad(
         if cfg.mode == "sketch" and not defer_encode:
             assert cs is not None, "sketch mode requires the runtime's sketch"
             table = cs.encode(g)
-            if cfg.max_grad_norm is not None:
+            if cfg.max_grad_norm is not None and not cfg.sketch_dense_clip:
+                # reference semantics: clip the TABLE (fed_worker.py:318)
                 table = cs.clip(table, cfg.max_grad_norm)
             g = table
         return g, results, n_valid

@@ -172,8 +172,12 @@ class FedRuntime:
         # psum over ICI — encode work drops from per-client to per-device
         # and the collective stays table-sized (the TPU analogue of the
         # reference's encode-before-NCCL-reduce).
+        # (the post-encode TABLE clip is per-client and kills deferral;
+        # the pre-encode dense clip preserves sketch linearity — the sum
+        # of clipped dense gradients encodes once)
         self._defer_encode = (cfg.mode == "sketch"
-                              and cfg.max_grad_norm is None)
+                              and (cfg.max_grad_norm is None
+                                   or cfg.sketch_dense_clip))
         # With deferred encode on a single device, the server can keep
         # momentum/error as dense (d,) PRE-IMAGES instead of (r, c) tables:
         # one enc+dec round-trip of the error per round injects the sketch's

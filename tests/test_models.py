@@ -111,12 +111,17 @@ def test_attn_impl_resolver_and_cpu_fallback():
                                                flash_causal_attention,
                                                resolve_attn)
 
+    from commefficient_tpu.models.gpt2 import auto_causal_attention
+
     assert resolve_attn("dense") is dense_causal_attention
     assert resolve_attn("flash") is flash_causal_attention
+    assert resolve_attn("auto") is auto_causal_attention
     with pytest.raises(ValueError, match="unknown attn_impl"):
         resolve_attn("paged")
     q = jax.random.normal(jax.random.PRNGKey(0), (2, 128, 4, 16))
     d = dense_causal_attention(q, q, q)
     f = flash_causal_attention(q, q, q)   # CPU => dense fallback
+    a = auto_causal_attention(q, q, q)    # S=128 < 1024 => dense
     np.testing.assert_array_equal(np.asarray(d), np.asarray(f))
-    assert sorted(ATTN_IMPLS) == ["dense", "flash"]
+    np.testing.assert_array_equal(np.asarray(d), np.asarray(a))
+    assert sorted(ATTN_IMPLS) == ["auto", "dense", "flash"]
