@@ -210,14 +210,16 @@ class FedConfig:
     attn_impl: str = "auto"
     # sketch-mode worker-gradient clipping (TPU-native extension): apply
     # --max_grad_norm to the DENSE per-client gradient before encoding
-    # (threshold x num_iters, same semantics as the dense modes) instead
-    # of the reference's post-encode table clip (fed_worker.py:318-319 —
-    # a bare-threshold, semantically different operation). Measured
-    # finding (runs/gpt2_conv/README.md): on the from-scratch GPT-2
-    # corpus BOTH clip placements interact pathologically with
-    # table-space error feedback (1.74 -> 2.40 nll) even though the same
-    # clip rescues the dense modes — prefer unclipped sketch there; the
-    # flag exists to reproduce and study that interaction. Disables the
+    # (threshold x num_iters, the same semantics as the dense modes)
+    # instead of the reference's post-encode table clip
+    # (fed_worker.py:318-319, bare threshold). Because an l2 clip is a
+    # rescaling and the encode is linear, the two placements apply the
+    # SAME operation at a matched threshold (pinned by
+    # test_sketch_dense_clip_wiring); this flag aligns the threshold
+    # semantics across modes. Measured finding (runs/gpt2_conv/
+    # README.md): clipping that rescues the dense modes degrades
+    # sketch-mode error feedback at every measured threshold — prefer
+    # unclipped sketch on from-scratch regimes. Disables the
     # fused-clients fast path (the clip is per-client); deferred encode
     # survives (clipped dense gradients still sum before one encode).
     sketch_dense_clip: bool = False
@@ -240,6 +242,13 @@ class FedConfig:
         assert self.dp_mode in DP_MODES, self.dp_mode
         assert self.pallas in ("auto", "on", "off"), self.pallas
         assert self.attn_impl in ("auto", "dense", "flash"), self.attn_impl
+        if self.sketch_dense_clip:
+            # silently ignoring the flag would let a clip study run
+            # unclipped — the exact wrong-conclusion failure it exists
+            # to prevent
+            assert self.mode == "sketch" and self.max_grad_norm is not None, \
+                "--sketch_dense_clip requires --mode sketch and " \
+                "--max_grad_norm"
         if self.mode == "fedavg":
             # reference invariants: utils.py:225-228
             assert self.local_batch_size == -1

@@ -121,9 +121,10 @@ def make_forward_grad(
         # norm of the SUMMED client gradient, which per-shard norms cannot
         # provide (partials are not orthogonal). --sketch_dense_clip
         # extends the same PRE-encode clip to sketch mode (the reference
-        # can only clip the post-encode table, fed_worker.py:318-319 — an
-        # 8x-tighter, semantically different operation; measured
-        # consequences in runs/gpt2_conv/README.md).
+        # can only clip the post-encode table, fed_worker.py:318-319 — by
+        # sketch linearity the same rescaling at a matched threshold, but
+        # with bare instead of x num_iters threshold semantics; measured
+        # study in runs/gpt2_conv/README.md).
         if cfg.max_grad_norm is not None and (
                 cfg.mode != "sketch" or cfg.sketch_dense_clip):
             g = clip_by_l2_norm(g, cfg.max_grad_norm * num_iters)

@@ -75,8 +75,7 @@ def main():
 
             run = jax.jit(chain, static_argnums=1)
             try:
-                float(run(vec, 1))
-                float(run(vec, reps))          # warmup the n=reps program
+                float(run(vec, reps))          # compile + warmup
                 t0 = time.time()
                 float(run(vec, reps))
                 dt = (time.time() - t0) / reps

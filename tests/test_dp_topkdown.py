@@ -88,3 +88,47 @@ def test_topk_down_client_weights_lag():
     # moved by top-k increments (k per round => at most 2k coords changed)
     changed = (np.abs(cw[participating] - w_init[participating]) > 0)
     assert changed.sum(axis=1).max() <= 2 * rt.cfg.k
+
+
+def test_sketch_dense_clip_wiring():
+    """--sketch_dense_clip (TPU-native extension): clips the DENSE worker
+    gradient before encode instead of the reference's post-encode table
+    clip. Pinned: (a) deferred encode survives (sketch linearity holds
+    for summed clipped gradients); (b) a non-binding threshold reproduces
+    the unclipped round exactly; (c) at a BINDING threshold the two
+    placements nearly coincide — l2 clipping is a rescaling and encode is
+    linear, so clip-then-encode = (t/||g||)·encode(g) while
+    encode-then-clip = (t/median_row_norm)·encode(g), and the count
+    sketch preserves norms in expectation (E||row||² = ||g||²). The flag
+    therefore matters for threshold SEMANTICS (the dense placement
+    scales with num_iters like the other modes; the reference's table
+    clip is bare), not for the operation applied."""
+    kw = dict(mode="sketch", error_type="virtual", local_momentum=0.0,
+              weight_decay=0.0, k=5, num_rows=3, num_cols=32, num_blocks=2,
+              track_bytes=False)
+    batch, mask, cids = make_batch(1)
+
+    rt_plain = make_rt(**kw)
+    rt_loose = make_rt(max_grad_norm=1e9, sketch_dense_clip=True, **kw)
+    rt_tight = make_rt(max_grad_norm=0.01, sketch_dense_clip=True, **kw)
+    rt_table = make_rt(max_grad_norm=0.01, **kw)
+    # dense clip keeps encode deferral; table clip kills it
+    assert rt_plain._defer_encode and rt_loose._defer_encode
+    assert rt_tight._defer_encode and not rt_table._defer_encode
+    # per-client clip disables the fused path
+    assert rt_plain._fused and not rt_loose._fused
+
+    outs = {}
+    for name, rt in (("plain", rt_plain), ("loose", rt_loose),
+                     ("tight", rt_tight), ("table", rt_table)):
+        s = rt.init_state()
+        for _ in range(2):
+            s, _ = rt.round(s, cids, batch, mask, 0.1)
+        outs[name] = np.asarray(s.ps_weights)
+    np.testing.assert_allclose(outs["plain"], outs["loose"],
+                               rtol=1e-5, atol=1e-7)
+    assert not np.allclose(outs["plain"], outs["tight"], rtol=1e-3)
+    # linearity equivalence of the two placements at a binding threshold
+    np.testing.assert_allclose(outs["tight"], outs["table"],
+                               rtol=0.1, atol=1e-4)
+    assert np.all(np.isfinite(outs["tight"]))
