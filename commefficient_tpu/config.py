@@ -79,6 +79,12 @@ class FedConfig:
     # 24-epoch accuracy curve stays well below 100% and keeps climbing
     synthetic_hard: bool = False
     synthetic_label_noise: float = 0.0
+    # train WITHOUT data augmentation (normalize-only transform).
+    # Implied by --synthetic_hard; needed standalone for any synthetic
+    # regime whose class evidence is per-pixel (crop/flip/shift
+    # augmentation scrambles prototype pixels and training flatlines at
+    # chance — measured on both CIFAR-hard and synthetic EMNIST)
+    no_augment: bool = False
     num_results_train: int = 2
     num_results_val: int = 2
 
@@ -237,6 +243,11 @@ class FedConfig:
     grad_size: int = 0
 
     def __post_init__(self):
+        # normalize the documented implication once, so every consumer
+        # can read cfg.no_augment directly (a hard-regime run that
+        # re-enabled augmentation would flatline at chance)
+        if self.synthetic_hard and not self.no_augment:
+            object.__setattr__(self, "no_augment", True)
         assert self.mode in MODES, self.mode
         assert self.error_type in ERROR_TYPES, self.error_type
         assert self.dp_mode in DP_MODES, self.dp_mode
@@ -346,6 +357,9 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--synthetic_per_class", type=int, default=64)
     p.add_argument("--synthetic_hard", action="store_true")
     p.add_argument("--synthetic_label_noise", type=float, default=0.0)
+    p.add_argument("--no_augment", action="store_true",
+                   help="train normalize-only (no crop/flip/shift); "
+                        "implied by --synthetic_hard")
 
     p.add_argument("--k", type=int, default=50_000)
     p.add_argument("--num_cols", type=int, default=500_000)

@@ -183,9 +183,11 @@ def build_datasets(cfg: FedConfig):
     # random-crop/flip augmentation scrambles it and training flatlines
     # at chance (same reason tests/test_learning.py trains its synthetic
     # runs un-augmented), so hard-mode runs train on the normalize-only
-    # transform
+    # transform; --no_augment requests the same standalone (any
+    # per-pixel-prototype synthetic regime, e.g. EMNIST's).
+    # cfg.no_augment is already normalized to include synthetic_hard.
     train_transform = transforms_for(
-        cfg.dataset_name, train=not cfg.synthetic_hard, seed=cfg.seed)
+        cfg.dataset_name, train=not cfg.no_augment, seed=cfg.seed)
     if cfg.do_test:
         kw["synthetic"] = True
     train_ds = ds_cls(cfg.dataset_dir, train=True, do_iid=cfg.do_iid,
@@ -250,7 +252,7 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
         train_ds, cfg.dataset_name, True, mesh=runtime.mesh,
         out_shardings=(runtime.batch_sharding()
                        if runtime.mesh is not None else None),
-        no_augment=cfg.synthetic_hard)
+        no_augment=cfg.no_augment)
     val_store = make_device_store(val_ds, cfg.dataset_name, False,
                                   mesh=runtime.mesh)
     if train_store is not None:

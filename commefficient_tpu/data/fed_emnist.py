@@ -28,9 +28,18 @@ IMG = 28
 
 
 def _synthetic_emnist(num_clients: int = 20, per_client: int = 24,
-                      seed: int = 99):
+                      seed: int = 99, proto_seed: int = 777):
+    """Writer-structured synthetic set. Class PROTOTYPES come from
+    ``proto_seed`` (fixed by default) while per-image noise and labels
+    come from ``seed`` — so the train split (seed 99) and the val split
+    (seed 7) describe the SAME classes with fresh noise, and validation
+    accuracy measures generalization. (Round-4 fix: prototypes used to
+    be drawn from ``seed`` too, which made the two splits' classes
+    UNRELATED and pinned every synthetic-EMNIST val accuracy at chance
+    by construction — the same design _synthetic_cifar already had.)"""
+    prng = np.random.RandomState(proto_seed)
+    protos = prng.rand(NUM_CLASSES, IMG, IMG).astype(np.float32)
     rng = np.random.RandomState(seed)
-    protos = rng.rand(NUM_CLASSES, IMG, IMG).astype(np.float32)
     images, targets, per = [], [], []
     for _ in range(num_clients):
         ys = rng.randint(0, NUM_CLASSES, size=per_client)
@@ -42,12 +51,53 @@ def _synthetic_emnist(num_clients: int = 20, per_client: int = 24,
     return np.concatenate(images), np.concatenate(targets), per
 
 
+# version tag of the synthetic generator's semantics; "shared-v1" =
+# train/val share class prototypes (proto_seed) — bump on any change to
+# _synthetic_emnist so stale prepared arrays re-prepare
+_SYNTH_PROTOS = "shared-v1"
+
+
 class FedEMNIST(FedDataset):
     def __init__(self, *args, synthetic=None, **kw):
         # True = force synthetic, False = require LEAF json, None = auto
         # fallback with a warning (zero-egress verification path)
         self._synthetic = synthetic
+        # synthetic-prep invalidation (same scheme as fed_cifar.py): a
+        # prepared synthetic cache whose generator marker mismatches the
+        # current one is stale — e.g. the round-4 prototype fix changed
+        # the arrays' semantics, and silently reusing a pre-fix cache
+        # would pin val accuracy at chance. Marker-less stats are left
+        # alone (possibly real-data preps) with a warning.
+        import json as _json
+        dataset_dir = args[0] if args else kw.get("dataset_dir")
+        pref = os.path.join(dataset_dir,
+                            f"stats_{type(self).__name__}.json")
+        if os.path.exists(pref):
+            try:
+                with open(pref) as f:
+                    marker = _json.load(f).get("synthetic")
+            except Exception:
+                marker = None
+            want_syn = (synthetic is True
+                        or (synthetic is None
+                            and not self._has_real_source(dataset_dir)))
+            expected = self._synth_marker() if want_syn else None
+            if marker is not None and marker != expected:
+                os.unlink(pref)       # ours and stale: re-prepare
+            elif marker is None and want_syn:
+                print(f"WARNING: reusing prepared data under {dataset_dir} "
+                      "that predates synthetic-prep markers; delete "
+                      f"{pref} to regenerate with the current synthetic "
+                      "settings")
         super().__init__(*args, **kw)
+
+    @classmethod
+    def _has_real_source(cls, dataset_dir: str) -> bool:
+        return bool(glob.glob(
+            os.path.join(dataset_dir, "train", "all_data*.json")))
+
+    def _synth_marker(self) -> dict:
+        return {"protos": _SYNTH_PROTOS}
 
     def _leaf_dir(self, split: str) -> str:
         return os.path.join(self.dataset_dir, split)
@@ -71,6 +121,7 @@ class FedEMNIST(FedDataset):
         return np.concatenate(images), np.concatenate(targets), per_client
 
     def _prepare(self, download: bool = False) -> None:
+        marker = None
         train = None if self._synthetic else self._read_leaf("train")
         val = None if self._synthetic else self._read_leaf("test")
         if train is None:
@@ -84,6 +135,7 @@ class FedEMNIST(FedDataset):
             train = _synthetic_emnist()
             vx, vy, _ = _synthetic_emnist(num_clients=4, seed=7)
             val = (vx, vy, None)
+            marker = self._synth_marker()
         if val is None:
             raise FileNotFoundError(
                 f"LEAF train split found under {self.dataset_dir} but the "
@@ -96,7 +148,7 @@ class FedEMNIST(FedDataset):
         vx, vy = val[0], val[1]
         np.savez(os.path.join(self.dataset_dir, f"{prefix}_val.npz"),
                  images=vx, targets=vy)
-        self.write_stats(per_client, len(vy))
+        self.write_stats(per_client, len(vy), synthetic=marker)
 
     def _load_arrays(self) -> None:
         fn = (self.data_fn("train.npz") if self.train

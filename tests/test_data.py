@@ -169,3 +169,31 @@ def test_synthetic_val_shares_prototypes():
         assert np.abs(m_tr - m_va).mean() < 20
     # ...but the images themselves differ (fresh noise)
     assert np.abs(tr_img.astype(float) - va_img.astype(float)).mean() > 10
+
+
+def test_emnist_synthetic_splits_share_prototypes(tmp_path):
+    """Regression (round-4 bug): the synthetic train and val splits must
+    describe the SAME classes — prototypes from a fixed proto_seed, only
+    noise from the split seed. (They used to draw prototypes from the
+    split seed, making every synthetic-EMNIST val accuracy chance by
+    construction.) Pinned structurally: each class's train-mean image is
+    closest to ITS OWN val-mean image."""
+    ds = FedEMNIST(str(tmp_path), synthetic=True)
+    val = FedEMNIST(str(tmp_path), train=False, synthetic=True)
+    tb = ds.gather(np.arange(len(ds)))
+    vb = val.gather(np.arange(len(val)))
+
+    def class_means(b):
+        xs, ys = b["image"][..., 0], b["target"]
+        return np.stack([xs[ys == c].mean(axis=0) for c in range(62)
+                         if (ys == c).any()]), sorted(set(ys.tolist()))
+
+    tm, tc = class_means(tb)
+    vm, vc = class_means(vb)
+    common = sorted(set(tc) & set(vc))
+    assert len(common) >= 10
+    ti = [tc.index(c) for c in common]
+    vi = [vc.index(c) for c in common]
+    d = ((tm[ti][:, None] - vm[vi][None]) ** 2).sum(axis=(-1, -2))
+    # own-class distance must be the row minimum for every common class
+    assert (d.argmin(axis=1) == np.arange(len(common))).all()
