@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""ImageNet-lineage round throughput: FixupResNet50 @ 224x224, the
+reference's only tuned recipe (imagenet.sh: 7 workers x local batch 64,
+uncompressed, virtual momentum, iid — SURVEY §6). Measures the full
+federated round (fused client gradients + reduce/server update) on one
+chip; prints one JSON line like the other benches.
+
+Kept OUT of the driver-run bench.py: a cold FixupResNet50@224 compile is
+minutes long and the driver artifact must never hang on it; run this
+standalone and the number is recorded in README.md.
+
+Usage: python scripts/bench_imagenet.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench_common import log, peak_flops, timed_rounds
+    from commefficient_tpu import models
+    from commefficient_tpu.config import FedConfig, enable_compilation_cache
+    from commefficient_tpu.core import FedRuntime
+    from commefficient_tpu.losses import make_cv_loss
+
+    log("devices:", jax.devices())
+    W, B, HW = 7, 64, 224
+    cfg = FedConfig(mode="uncompressed", error_type="virtual",
+                    local_momentum=0.0, virtual_momentum=0.9,
+                    weight_decay=1e-4, num_workers=W, local_batch_size=B,
+                    num_clients=7, do_iid=True, track_bytes=False,
+                    num_results_train=2)
+    enable_compilation_cache(cfg)
+    model = models.FixupResNet50(num_classes=1000)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, HW, HW, 3), jnp.float32))
+    loss_fn = make_cv_loss(model, "bfloat16")
+    runtime = FedRuntime(cfg, params, loss_fn, num_clients=cfg.num_clients)
+    log(f"grad size {runtime.cfg.grad_size}")
+
+    rng = np.random.RandomState(0)
+    batch = {"image": jnp.asarray(rng.randn(W, B, HW, HW, 3), jnp.float32),
+             "target": jnp.asarray(rng.randint(0, 1000, (W, B)), jnp.int32)}
+    mask = jnp.ones((W, B), bool)
+    ids = jnp.arange(W, dtype=jnp.int32)
+
+    n_rounds = 10
+    t0 = time.time()
+    dt, metrics = timed_rounds(runtime, (ids, batch, mask, 0.1),
+                               warmup=2, rounds=n_rounds, desc="imagenet")
+    imgs = n_rounds * W * B
+    ips = imgs / dt
+    loss = float(np.asarray(metrics["results"][0]).mean())
+    log(f"{n_rounds} rounds in {dt:.3f}s -> {ips:.1f} img/s, loss {loss:.3f}")
+
+    # analytic model FLOPs: ResNet-50 fwd ~4.1 GFLOP per 224x224 image
+    # (standard figure; Fixup changes normalization, not conv shapes),
+    # bwd = 2x fwd
+    flops = 3 * 4.1e9 * W * B
+    peak = peak_flops(jax.devices()[0])
+    mfu = (flops * n_rounds / dt) / peak
+    log(f"model FLOPs/round {flops:.3e}, MFU {mfu:.3f}")
+    print(json.dumps({"metric": "imagenet_fixupresnet50_round_throughput",
+                      "value": round(ips, 1), "unit": "images/sec",
+                      "mfu": round(mfu, 4),
+                      "round_images": W * B,
+                      "total_s": round(time.time() - t0, 1)}))
+
+
+if __name__ == "__main__":
+    main()
