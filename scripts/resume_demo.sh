@@ -1,0 +1,28 @@
+set -euo pipefail
+cd /root/repo
+OUT=runs/gpt2_conv
+CK=/tmp/resume_ck
+rm -rf "$CK"
+COMMON=(--mode sketch --error_type virtual --num_cols 524288 --num_rows 5
+        --k 50000 --approx_topk --num_workers 8 --local_batch_size 8
+        --microbatch_size 8 --max_seq_len 64 --valid_batch_size 64
+        --weight_decay 0 --local_momentum 0 --virtual_momentum 0.9
+        --dataset_dir "$OUT/data" --seed 21 --num_epochs 12
+        --checkpoint_path "$CK")
+# uninterrupted 12-epoch run (checkpoints every 3 so the interrupted
+# variant can resume from epoch 6)
+python gpt2_train.py "${COMMON[@]}" --checkpoint_every 3 \
+    2>&1 | tee "$OUT/resume_full12.log"
+# wipe later checkpoints so the resume starts at epoch 6, then resume
+python - "$CK" <<'PYEOF'
+import glob, os, sys
+for fn in glob.glob(os.path.join(sys.argv[1], "gpt2_doubleheads", "*")):
+    base = os.path.basename(fn)
+    if any(f"_{ep:06d}" in base or f"{ep}" == base.split("_")[-1].split(".")[0]
+           for ep in (9, 12)):
+        os.remove(fn)
+        print("removed", base)
+PYEOF
+python gpt2_train.py "${COMMON[@]}" --resume \
+    2>&1 | tee "$OUT/resume_from6.log"
+echo RESUME DEMO DONE
