@@ -28,9 +28,11 @@ import numpy as np
 
 from commefficient_tpu.data.fed_dataset import FedDataset
 
-# version tag for the synthetic generator's semantics; "shared-v2" =
-# train/val share class prototypes (val differs only in noise)
-_SYNTH_PROTOS = "shared-v2"
+# version tag for the synthetic generator's semantics; "shared-v3" =
+# train/val share class prototypes (val differs only in noise) and the
+# EASY branch's prototypes are low-frequency (coarse 8x8 upsampled —
+# see _synthetic_cifar) so downsampling stems can learn them
+_SYNTH_PROTOS = "shared-v3"
 
 # hard-regime knobs (see _synthetic_cifar hard=True), calibrated by TPU
 # sweeps so a 24-epoch run lands below 100% val accuracy EVEN
@@ -86,7 +88,18 @@ def _synthetic_cifar(num_classes: int, per_class: int, img_hw: int = 32,
         protos = np.clip(base + where * signs * _HARD_DELTA, 0, 255)
         noise_amp = _HARD_NOISE
     else:
-        protos = prng.randint(0, 255, size=(num_classes, img_hw, img_hw, 3))
+        # LOW-FREQUENCY prototypes (coarse 8x8 patterns upsampled to
+        # img_hw): class evidence that survives downsampling stems.
+        # iid-per-pixel prototypes (the shared-v2 design) are destroyed
+        # by any stride-2 7x7 stem — a torchvision resnet50 measured
+        # train-acc 54% / val-acc chance on them (pure high-frequency
+        # memorization), while the same run on low-frequency prototypes
+        # generalizes. Natural images are low-frequency-dominated, so
+        # this is also the more faithful synthetic stand-in.
+        coarse = prng.randint(0, 255, size=(num_classes, 8, 8, 3))
+        reps = -(-img_hw // 8)      # ceil: cover img_hw, then trim
+        protos = np.kron(coarse, np.ones((1, reps, reps, 1), int))
+        protos = protos[:, :img_hw, :img_hw]
         noise_amp = 60
     rng = np.random.RandomState(seed)
     images, targets = [], []
