@@ -414,6 +414,30 @@ def main(argv=None):
     init_x = jnp.asarray(sample["image"])
     params = model.init(jax.random.PRNGKey(cfg.seed), init_x)
 
+    # stateless batch-norm eval caveat (models/layers.py BatchStatNorm):
+    # small eval batches compound stat noise with DEPTH — measured
+    # chance-level val accuracy at depth 50 with batch 8 where batch 256
+    # tracks train accuracy. Warn whenever a batch-normed model will
+    # evaluate on small batches.
+    bsn_scopes = set()
+    for path, _ in jax.tree_util.tree_flatten_with_path(params)[0]:
+        keys = [str(getattr(p, "key", p)) for p in path]
+        for i, k in enumerate(keys):
+            if "BatchStatNorm" in k:
+                bsn_scopes.add("/".join(keys[: i + 1]))
+                break
+    n_bsn = len(bsn_scopes)
+    # threshold between ResNet-9's 8 norm layers (measured robust at
+    # batch 8) and the 20+ of the torchvision-family depth-18+ ports
+    if n_bsn > 10 and cfg.valid_batch_size < 64:
+        print(f"WARNING: {cfg.model} stacks {n_bsn} batch-stat norm "
+              f"layers and --valid_batch_size {cfg.valid_batch_size} < "
+              "64: eval batches normalize by their OWN statistics, and "
+              "small-batch stat noise compounds with depth (measured: "
+              "chance-level val accuracy at depth 50 / batch 8 where "
+              "batch 256 tracks train). Raise --valid_batch_size.",
+              file=sys.stderr)
+
     frozen = None
     if cfg.do_finetune:
         params, frozen = load_finetune_params(cfg, model, params)

@@ -106,8 +106,14 @@ class ValSampler:
     def __iter__(self):
         for start in range(0, self.num_items, self.batch):
             n = min(self.batch, self.num_items - start)
-            idx = np.zeros(self.batch, dtype=np.int64)
-            idx[:n] = np.arange(start, start + n)
+            # pad the final partial chunk by WRAPPING to the start of the
+            # val set (not by repeating item 0): the mask excludes padding
+            # from every metric either way, but batch-stat-normalized
+            # models compute eval statistics over the WHOLE chunk — 240
+            # copies of one image would dominate the final chunk's norm
+            # statistics and distort the real items' predictions
+            idx = np.arange(start, start + self.batch,
+                            dtype=np.int64) % self.num_items
             mask = np.zeros(self.batch, dtype=bool)
             mask[:n] = True
             yield idx, mask

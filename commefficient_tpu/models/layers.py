@@ -60,6 +60,15 @@ class BatchStatNorm(nn.Module):
 
     Learned per-channel scale/bias; normalization over (N, H, W). See module
     docstring for why this replaces the reference's stateful BatchNorm2d.
+
+    EVAL CAVEAT (measured, round 4): because eval batches normalize by
+    their OWN statistics, the stat noise of a small eval batch compounds
+    with depth — a 50-layer torchvision resnet50 evaluated with 8-image
+    batches returns chance-level accuracy on data it fits to 94% train
+    accuracy, while the same checkpoint evaluated with 256-image batches
+    tracks train accuracy. Shallow stacks (ResNet-9) are robust at batch
+    8. Use ``--valid_batch_size`` >= 64 with deep batch-normed models
+    (cv_train warns); or pick ``norm='layer'`` for batch-size-free eval.
     """
 
     epsilon: float = 1e-5
