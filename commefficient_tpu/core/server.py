@@ -143,6 +143,8 @@ def server_update(
         # error feedback + momentum factor masking at the update support
         Verr = jnp.where(mask, 0.0, Verr)
         Vvel = jnp.where(mask, 0.0, Vvel)
+        if cfg.error_decay < 1.0:
+            Verr = cfg.error_decay * Verr
         return update * lr, Vvel, Verr, mask
 
     if cfg.mode == "local_topk":
@@ -176,6 +178,8 @@ def server_update(
                                             approx=cfg.approx_topk)
             Verr = Verr.at[upd_idx].set(0.0)           # error feedback
             Vvel = Vvel.at[upd_idx].set(0.0)           # momentum mask
+            if cfg.error_decay < 1.0:
+                Verr = cfg.error_decay * Verr
             return update * lr, Vvel, Verr, None
         Vvel = gradient + rho * Vvelocity
         Verr = Verror + Vvel  # virtual error (the only legal type, see above)
@@ -196,6 +200,8 @@ def server_update(
             enc_upd, enc_vel = cs.encode(jnp.stack([update, vel_at_support]))
             Verr = Verr - enc_upd
             Vvel = Vvel - enc_vel
+            if cfg.error_decay < 1.0:
+                Verr = cfg.error_decay * Verr
             return update * lr, Vvel, Verr, None
         update, upd_idx = cs.unsketch_with_idx(
             Verr, k=cfg.k, approx=cfg.approx_topk)
@@ -203,9 +209,25 @@ def server_update(
         # (reference fed_aggregator.py:593-595) — the update is k-sparse, so
         # the sparse encode is exact at O(k·r) instead of O(d·r)
         sketched_update = cs.encode_at(update, upd_idx)
-        mask = sketched_update != 0
-        Vvel = jnp.where(mask, 0.0, Vvel)
-        Verr = jnp.where(mask, 0.0, Verr)
+        if cfg.sketch_ef == "subtract":
+            # Subtractive error feedback (TPU-native extension, see
+            # config.py sketch_ef): remove exactly the extracted estimates
+            # instead of zeroing whole cells — colliding coordinates keep
+            # their accumulated error. Momentum factor masking becomes
+            # "subtract the velocity's estimated values at the support"
+            # (the same transformation the reference's zeroing applies to
+            # the cells, restricted to the extracted mass). Lossless limit
+            # (c >= d, no collisions): bit-for-bit the zero rule.
+            Vvel = Vvel - cs.encode_vals_at(cs.decode_at(Vvel, upd_idx),
+                                            upd_idx)
+            Verr = Verr - sketched_update
+            mask = None
+        else:
+            mask = sketched_update != 0
+            Vvel = jnp.where(mask, 0.0, Vvel)
+            Verr = jnp.where(mask, 0.0, Verr)
+        if cfg.error_decay < 1.0:
+            Verr = cfg.error_decay * Verr
         return update * lr, Vvel, Verr, mask
 
     raise ValueError(f"unknown mode {cfg.mode}")

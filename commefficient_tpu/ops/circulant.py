@@ -207,22 +207,42 @@ class CirculantSketch:
             rows.append(rolled.sum(axis=0))
         return jnp.stack(rows)
 
+    def _buckets_of(self, j: int, idx: jax.Array) -> jax.Array:
+        """Bucket of global coordinate i in row j:
+        (i mod c + shifts[j][i // c]) mod c — the ONE definition shared by
+        encode_at and decode_at (signs come from ``_sign_of``)."""
+        s = jnp.asarray(self.shifts[j], jnp.int32)[idx // self.c]
+        return (idx.astype(jnp.int32) % self.c + s) % self.c
+
     def encode_at(self, vec: jax.Array, idx: jax.Array) -> jax.Array:
         """Encode a k-sparse vector given its support indices: equals
         ``encode(vec)`` when vec is zero outside ``idx``, at O(k·r)
         scatter-add cost instead of the O(d·r) roll pass (~2 ms vs ~87 ms
         at d=124M, k=50k — this runs every round for the server's
-        error-feedback re-encode). Bucket of global coordinate i in row j:
-        (i mod c + shifts[j][i // c]) mod c; signs from the same mixer as
-        ``_signs``."""
-        vals = vec[idx]
+        error-feedback re-encode)."""
+        return self.encode_vals_at(vec[idx], idx)
+
+    def encode_vals_at(self, vals: jax.Array, idx: jax.Array) -> jax.Array:
+        """``encode_at`` taking the k support VALUES directly — no dense
+        (d,) staging buffer (the subtractive-EF momentum masking's path,
+        core/server.py)."""
         rows = []
         for j in range(self.r):
-            s = jnp.asarray(self.shifts[j], jnp.int32)[idx // self.c]
-            buckets = (idx.astype(jnp.int32) % self.c + s) % self.c
             rows.append(jax.ops.segment_sum(self._sign_of(j, idx) * vals,
-                                            buckets, num_segments=self.c))
+                                            self._buckets_of(j, idx),
+                                            num_segments=self.c))
         return jnp.stack(rows)
+
+    def decode_at(self, table: jax.Array, idx: jax.Array) -> jax.Array:
+        """Median-of-r estimates of the coordinates ``idx`` only: equals
+        ``decode(table)[idx]`` at O(k·r) gather cost instead of the O(d·r)
+        full decode (used by the subtractive error-feedback rule's
+        momentum masking, core/server.py)."""
+        ests = []
+        for j in range(self.r):
+            ests.append(self._sign_of(j, idx)
+                        * table[j, self._buckets_of(j, idx)])
+        return median_axis0(jnp.stack(ests))
 
     def decode(self, table: jax.Array) -> jax.Array:
         assert table.shape == self.table_shape, (table.shape,

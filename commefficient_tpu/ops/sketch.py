@@ -91,8 +91,14 @@ class CountSketch:
     def encode_at(self, vec: jax.Array, idx: jax.Array) -> jax.Array:
         return sketch_encode_at(self, vec, idx)
 
+    def encode_vals_at(self, vals: jax.Array, idx: jax.Array) -> jax.Array:
+        return sketch_encode_vals_at(self, vals, idx)
+
     def decode(self, table: jax.Array) -> jax.Array:
         return sketch_decode(self, table)
+
+    def decode_at(self, table: jax.Array, idx: jax.Array) -> jax.Array:
+        return sketch_decode_at(self, table, idx)
 
     def unsketch(self, table: jax.Array, k: int, approx: bool = False):
         return sketch_unsketch(self, table, k, approx=approx)
@@ -235,11 +241,28 @@ def sketch_encode_at(cs: CountSketch, vec: jax.Array,
     """Encode a k-sparse vector given its support indices: exactly equals
     ``sketch_encode(cs, vec)`` when ``vec`` is zero outside ``idx``, but costs
     O(k·r) scatter updates instead of O(d·r)."""
+    return sketch_encode_vals_at(cs, vec[idx], idx)
+
+
+def sketch_encode_vals_at(cs: CountSketch, vals: jax.Array,
+                          idx: jax.Array) -> jax.Array:
+    """``sketch_encode_at`` taking the k support VALUES directly — no dense
+    (d,) staging buffer (subtractive-EF momentum masking, core/server.py)."""
     buckets, signs = _buckets_signs(cs, idx.astype(_U32))
-    vals = signs * vec[idx][None, :]
+    svals = signs * vals[None, :]
     return jax.vmap(
         lambda b, v: jax.ops.segment_sum(v, b, num_segments=cs.c)
-    )(buckets, vals)
+    )(buckets, svals)
+
+
+def sketch_decode_at(cs: CountSketch, table: jax.Array,
+                     idx: jax.Array) -> jax.Array:
+    """Median-of-r estimates of the coordinates ``idx`` only: equals
+    ``sketch_decode(cs, table)[idx]`` at O(k·r) gather cost (used by the
+    subtractive error-feedback rule's momentum masking, core/server.py)."""
+    buckets, signs = _buckets_signs(cs, idx.astype(_U32))
+    rows = jnp.arange(cs.r)[:, None]
+    return median_axis0(signs * table[rows, buckets])
 
 
 def sketch_l2estimate(cs: CountSketch, table: jax.Array) -> jax.Array:

@@ -156,6 +156,18 @@ class TestSketch:
         true = float(jnp.linalg.norm(v))
         assert abs(est - true) / true < 0.15
 
+    def test_decode_at_matches_decode(self, cs):
+        """decode_at(table, idx) == decode(table)[idx] — the contract the
+        subtractive error-feedback momentum masking relies on
+        (core/server.py)."""
+        rng = np.random.RandomState(9)
+        v = jnp.asarray(rng.randn(D).astype(np.float32))
+        table = sketch_encode(cs, v)
+        idx = jnp.asarray(rng.choice(D, 40, replace=False))
+        np.testing.assert_allclose(
+            np.asarray(cs.decode_at(table, idx)),
+            np.asarray(cs.decode(table))[np.asarray(idx)], atol=1e-5)
+
     def test_encode_jit_and_vmap(self, cs):
         rng = np.random.RandomState(8)
         vs = jnp.asarray(rng.randn(3, D).astype(np.float32))
@@ -242,6 +254,17 @@ class TestCirculantSketch:
             jnp.asarray(rng.randn(50), jnp.float32))
         np.testing.assert_allclose(np.asarray(ccs.encode_at(v, idx)),
                                    np.asarray(ccs.encode(v)), atol=1e-4)
+
+    def test_decode_at_matches_decode(self, ccs):
+        """decode_at(table, idx) == decode(table)[idx] for the circulant
+        impl (subtractive-EF momentum masking contract, core/server.py)."""
+        rng = np.random.RandomState(10)
+        v = jnp.asarray(rng.randn(D).astype(np.float32))
+        table = ccs.encode(v)
+        idx = jnp.asarray(rng.choice(D, 40, replace=False))
+        np.testing.assert_allclose(
+            np.asarray(ccs.decode_at(table, idx)),
+            np.asarray(ccs.decode(table))[np.asarray(idx)], atol=1e-5)
 
     def test_l2_estimate(self, ccs):
         rng = np.random.RandomState(6)
