@@ -92,6 +92,12 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
     Returns (ckpt_mgr_or_None, start_epoch, restored_state_or_None)."""
     if not (cfg.do_checkpoint or cfg.do_resume or cfg.checkpoint_every):
         return None, 0, None
+    # use the runtime's RESOLVED config from here on: num_cols may have
+    # been auto-sized at runtime init (config.auto_num_cols), and the
+    # sketch-generation marker below must describe the tables actually
+    # built — a marker computed from the caller's pre-runtime copy would
+    # let a geometry-mismatched resume slip past the guard
+    cfg = runtime.cfg
     from commefficient_tpu.checkpoint import (CheckpointManager,
                                               params_fingerprint)
     mgr = CheckpointManager(os.path.join(cfg.checkpoint_path, name),

@@ -19,7 +19,6 @@ exercisable end-to-end.
 
 from __future__ import annotations
 
-import json
 import os
 import pickle
 from typing import Optional
@@ -143,31 +142,10 @@ class FedCIFAR10(FedDataset):
         # Prep-config invalidation for OUR (prefixed) prepared stats:
         # synthetic preps record their size + generator version, so
         # changing --synthetic_per_class (or a generator fix) re-prepares
-        # instead of silently reusing stale arrays, and a synthetic prep
-        # is replaced once the real raw source appears. Marker-less stats
-        # are left alone (they may be real-data preps whose raw source was
-        # since removed — regenerating would destroy them) with a warning
-        # when a synthetic prep was requested.
+        # instead of silently reusing stale arrays (shared base-class
+        # policy: FedDataset._invalidate_stale_synth_prep)
         dataset_dir = args[0] if args else kw.get("dataset_dir")
-        pref = os.path.join(dataset_dir,
-                            f"stats_{type(self).__name__}.json")
-        if os.path.exists(pref):
-            try:
-                with open(pref) as f:
-                    marker = json.load(f).get("synthetic")
-            except Exception:
-                marker = None
-            want_syn = (synthetic is True
-                        or (synthetic is None
-                            and not self._has_real_source(dataset_dir)))
-            expected = self._synth_marker() if want_syn else None
-            if marker is not None and marker != expected:
-                os.unlink(pref)       # ours and stale: re-prepare
-            elif marker is None and want_syn:
-                print(f"WARNING: reusing prepared data under {dataset_dir} "
-                      "that predates synthetic-prep markers; delete "
-                      f"{pref} to regenerate with the current synthetic "
-                      "settings")
+        self._invalidate_stale_synth_prep(dataset_dir, synthetic)
         super().__init__(*args, **kw)
 
     @classmethod

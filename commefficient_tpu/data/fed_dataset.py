@@ -93,6 +93,65 @@ class FedDataset:
             self.iid_shuffle = np.random.RandomState(seed).permutation(
                 len(self))
 
+    def _invalidate_stale_synth_prep(self, dataset_dir: str,
+                                     synthetic) -> None:
+        """Synthetic-prep invalidation, shared by every dataset with a
+        synthetic fallback (was duplicated near-verbatim in FedCIFAR and
+        FedEMNIST — ADVICE r4). Call BEFORE super().__init__ from a
+        subclass that defines ``_has_real_source`` and ``_synth_marker``.
+
+        A prepared cache under OUR prefixed stats records the generator
+        marker it was built with; a mismatch (knob change, generator fix)
+        unlinks the stats so __init__ re-prepares. Marker-less stats:
+
+        - with a real raw source present they may be real-data preps whose
+          provenance we cannot verify — preserved with a warning;
+        - with NO real source and a synthetic prep requested, they are
+          almost certainly a stale pre-marker synthetic cache, and
+          silently reusing one reproduces the exact failure the markers
+          exist to prevent (e.g. val accuracy pinned at chance on pre-fix
+          EMNIST prototypes) — re-prepared (ADVICE r4). Re-preparation is
+          NON-DESTRUCTIVE: the old prefixed stats + data files are
+          renamed to ``*.pre-marker.bak`` first, because this case can
+          also be a real-data prep whose raw source was deleted to save
+          space — irreplaceable, and a user who hits that can rename the
+          .bak files back.
+        """
+        pref = os.path.join(dataset_dir,
+                            f"stats_{type(self).__name__}.json")
+        if not os.path.exists(pref):
+            return
+        try:
+            with open(pref) as f:
+                marker = json.load(f).get("synthetic")
+        except Exception:
+            marker = None
+        has_real = self._has_real_source(dataset_dir)
+        want_syn = (synthetic is True
+                    or (synthetic is None and not has_real))
+        expected = self._synth_marker() if want_syn else None
+        if marker is not None and marker != expected:
+            os.unlink(pref)       # ours and stale: re-prepare
+        elif marker is None and want_syn:
+            if not has_real:
+                print(f"WARNING: prepared data under {dataset_dir} "
+                      "predates synthetic-prep markers and no real raw "
+                      "source is present: treating it as a stale "
+                      "synthetic cache and re-preparing (the old files "
+                      "are kept as *.pre-marker.bak in case this was a "
+                      "real-data prep whose raw source was removed)")
+                import glob as _glob
+                prefix = type(self).__name__
+                for fn in _glob.glob(
+                        os.path.join(dataset_dir, f"{prefix}_*")) + [pref]:
+                    if not fn.endswith(".pre-marker.bak"):
+                        os.replace(fn, fn + ".pre-marker.bak")
+            else:
+                print(f"WARNING: reusing prepared data under {dataset_dir} "
+                      "that predates synthetic-prep markers; delete "
+                      f"{pref} to regenerate with the current synthetic "
+                      "settings")
+
     # ---------------------------------------------------------------- meta
 
     def _prefixed_stats_fn(self) -> str:

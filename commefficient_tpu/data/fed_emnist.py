@@ -62,33 +62,12 @@ class FedEMNIST(FedDataset):
         # True = force synthetic, False = require LEAF json, None = auto
         # fallback with a warning (zero-egress verification path)
         self._synthetic = synthetic
-        # synthetic-prep invalidation (same scheme as fed_cifar.py): a
-        # prepared synthetic cache whose generator marker mismatches the
-        # current one is stale — e.g. the round-4 prototype fix changed
-        # the arrays' semantics, and silently reusing a pre-fix cache
-        # would pin val accuracy at chance. Marker-less stats are left
-        # alone (possibly real-data preps) with a warning.
-        import json as _json
+        # synthetic-prep invalidation: shared base-class policy (see
+        # FedDataset._invalidate_stale_synth_prep — e.g. the round-4
+        # prototype fix changed the arrays' semantics, and silently
+        # reusing a pre-fix cache would pin val accuracy at chance)
         dataset_dir = args[0] if args else kw.get("dataset_dir")
-        pref = os.path.join(dataset_dir,
-                            f"stats_{type(self).__name__}.json")
-        if os.path.exists(pref):
-            try:
-                with open(pref) as f:
-                    marker = _json.load(f).get("synthetic")
-            except Exception:
-                marker = None
-            want_syn = (synthetic is True
-                        or (synthetic is None
-                            and not self._has_real_source(dataset_dir)))
-            expected = self._synth_marker() if want_syn else None
-            if marker is not None and marker != expected:
-                os.unlink(pref)       # ours and stale: re-prepare
-            elif marker is None and want_syn:
-                print(f"WARNING: reusing prepared data under {dataset_dir} "
-                      "that predates synthetic-prep markers; delete "
-                      f"{pref} to regenerate with the current synthetic "
-                      "settings")
+        self._invalidate_stale_synth_prep(dataset_dir, synthetic)
         super().__init__(*args, **kw)
 
     @classmethod

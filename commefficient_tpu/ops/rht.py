@@ -1,5 +1,10 @@
-"""Stratified Subsampled Randomized Hadamard Transform (SRHT) sketch — the
-MXU-native alternative to the hash-based count sketch in ``ops/sketch.py``.
+"""Stratified Subsampled Randomized Hadamard Transform (SRHT) — a
+LOSSLESS-REGIME / DIAGNOSTIC transform, not a co-equal alternative to the
+count sketches for compressing runs (it measurably diverges under FetchSGD
+error feedback at r·c << d; see "Regime of validity"). Its practical roles:
+exact-roundtrip configurations at r·c >= d, where its MXU Hadamard is the
+fastest path, and reproducing the divergence study. For compressed training
+use ``circ`` (default) or ``hash``.
 
 Why this exists
 ---------------

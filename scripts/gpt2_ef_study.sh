@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Round-5 sketch-space stabilization study (VERDICT r4 next-round #1):
+# subtractive error feedback on the gpt2_conv regime, clipped and
+# unclipped arms. Same corpus/recipe as scripts/gpt2_convergence.sh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT=runs/gpt2_conv
+mkdir -p "$OUT"
+[ -f "$OUT/data/personachat_self_original.json" ] || \
+    python scripts/make_persona_corpus.py "$OUT/data"
+
+COMMON=(--num_epochs 24 --num_workers 8 --local_batch_size 8
+        --microbatch_size 8 --max_seq_len 64 --valid_batch_size 64
+        --weight_decay 0 --local_momentum 0 --virtual_momentum 0.9
+        --eval_before_start --dataset_dir "$OUT/data" --seed 21)
+
+run() {
+    local name=$1; shift
+    echo "=== $name ==="
+    python gpt2_train.py "$@" "${COMMON[@]}" 2>&1 | tee "$OUT/$name.log"
+    python - "$OUT/$name.log" "$OUT/$name.tsv" <<'PYEOF'
+import math, re, sys
+rows = ["epoch\thours\ttest_nll\tppl\tmc_acc"]
+for line in open(sys.argv[1]):
+    f = line.split()
+    if len(f) == 10 and re.fullmatch(r"\d+", f[0]):
+        ep, nll, acc, total = int(f[0]), float(f[5]), float(f[6]), float(f[9])
+        rows.append(f"{ep}\t{total/3600:.8f}\t{nll:.4f}"
+                    f"\t{math.exp(min(nll, 20)):.2f}\t{acc:.4f}")
+with open(sys.argv[2], "w") as out:
+    out.write("\n".join(rows) + "\n")
+print("wrote", sys.argv[2])
+PYEOF
+}
+
+for arm in "$@"; do
+  case "$arm" in
+    sub_clip1) run gpt2_sketch24_sub_clip1 --mode sketch --error_type virtual \
+        --num_cols 524288 --num_rows 5 --k 50000 --approx_topk \
+        --sketch_ef subtract --max_grad_norm 1 ;;
+    sub) run gpt2_sketch24_sub --mode sketch --error_type virtual \
+        --num_cols 524288 --num_rows 5 --k 50000 --approx_topk \
+        --sketch_ef subtract ;;
+    sub_clip1_k200k) run gpt2_sketch24_sub_clip1_k200k --mode sketch \
+        --error_type virtual --num_cols 524288 --num_rows 5 --k 200000 \
+        --approx_topk --sketch_ef subtract --max_grad_norm 1 ;;
+    *) echo "unknown arm $arm"; exit 1 ;;
+  esac
+done
+echo STUDY_DONE

@@ -114,7 +114,10 @@ def test_attn_impl_resolver_and_cpu_fallback():
     from commefficient_tpu.models.gpt2 import auto_causal_attention
 
     assert resolve_attn("dense") is dense_causal_attention
-    assert resolve_attn("flash") is flash_causal_attention
+    # "flash" resolves to a warn-on-fallback variant of the kernel
+    # (ADVICE r4: explicit flash requests must not silently run dense)
+    assert resolve_attn("flash").func is flash_causal_attention
+    assert resolve_attn("flash").keywords == {"_warn_fallback": True}
     assert resolve_attn("auto") is auto_causal_attention
     with pytest.raises(ValueError, match="unknown attn_impl"):
         resolve_attn("paged")
