@@ -133,7 +133,14 @@ class FedDataset:
         if marker is not None and marker != expected:
             os.unlink(pref)       # ours and stale: re-prepare
         elif marker is None and want_syn:
-            if not has_real:
+            # rename-aside only when no reference-style legacy stats.json
+            # could take over: removing the prefixed stats would otherwise
+            # flip __init__ into legacy-layout ADOPTION (loading the
+            # legacy arrays instead of re-preparing), contradicting the
+            # warning below
+            legacy_present = os.path.exists(
+                os.path.join(dataset_dir, "stats.json"))
+            if not has_real and not legacy_present:
                 print(f"WARNING: prepared data under {dataset_dir} "
                       "predates synthetic-prep markers and no real raw "
                       "source is present: treating it as a stale "

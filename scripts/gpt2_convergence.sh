@@ -23,21 +23,7 @@ run() {
     echo "=== $name ==="
     python gpt2_train.py "$@" "${COMMON[@]}" 2>&1 | tee "$OUT/$name.log"
     # per-epoch TSV artifact: epoch, hours, test NLL, ppl, MC accuracy
-    python - "$OUT/$name.log" "$OUT/$name.tsv" <<'EOF'
-import math, re, sys
-rows = ["epoch\thours\ttest_nll\tppl\tmc_acc"]
-for line in open(sys.argv[1]):
-    f = line.split()
-    # TableLogger rows: epoch lr train_time train_loss train_acc
-    #                   test_loss test_acc down up total_time
-    if len(f) == 10 and re.fullmatch(r"\d+", f[0]):
-        ep, nll, acc, total = int(f[0]), float(f[5]), float(f[6]), float(f[9])
-        rows.append(f"{ep}\t{total/3600:.8f}\t{nll:.4f}"
-                    f"\t{math.exp(min(nll, 20)):.2f}\t{acc:.4f}")
-with open(sys.argv[2], "w") as out:
-    out.write("\n".join(rows) + "\n")
-print("wrote", sys.argv[2])
-EOF
+    python scripts/gpt2log2tsv.py "$OUT/$name.log" "$OUT/$name.tsv"
 }
 
 run gpt2_sketch24 --mode sketch --error_type virtual \

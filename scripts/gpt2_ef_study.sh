@@ -18,19 +18,7 @@ run() {
     local name=$1; shift
     echo "=== $name ==="
     python gpt2_train.py "$@" "${COMMON[@]}" 2>&1 | tee "$OUT/$name.log"
-    python - "$OUT/$name.log" "$OUT/$name.tsv" <<'PYEOF'
-import math, re, sys
-rows = ["epoch\thours\ttest_nll\tppl\tmc_acc"]
-for line in open(sys.argv[1]):
-    f = line.split()
-    if len(f) == 10 and re.fullmatch(r"\d+", f[0]):
-        ep, nll, acc, total = int(f[0]), float(f[5]), float(f[6]), float(f[9])
-        rows.append(f"{ep}\t{total/3600:.8f}\t{nll:.4f}"
-                    f"\t{math.exp(min(nll, 20)):.2f}\t{acc:.4f}")
-with open(sys.argv[2], "w") as out:
-    out.write("\n".join(rows) + "\n")
-print("wrote", sys.argv[2])
-PYEOF
+    python scripts/gpt2log2tsv.py "$OUT/$name.log" "$OUT/$name.tsv"
 }
 
 for arm in "$@"; do
@@ -43,6 +31,19 @@ for arm in "$@"; do
         --sketch_ef subtract ;;
     sub_clip1_k200k) run gpt2_sketch24_sub_clip1_k200k --mode sketch \
         --error_type virtual --num_cols 524288 --num_rows 5 --k 200000 \
+        --approx_topk --sketch_ef subtract --max_grad_norm 1 ;;
+    clip1_decay95) run gpt2_sketch24_clip1_decay95 --mode sketch \
+        --error_type virtual --num_cols 524288 --num_rows 5 --k 50000 \
+        --approx_topk --max_grad_norm 1 --error_decay 0.95 ;;
+    sub_clip1_decay90) run gpt2_sketch24_sub_clip1_decay90 --mode sketch \
+        --error_type virtual --num_cols 524288 --num_rows 5 --k 50000 \
+        --approx_topk --sketch_ef subtract --max_grad_norm 1 \
+        --error_decay 0.90 ;;
+    clip1_k200k) run gpt2_sketch24_clip1_k200k --mode sketch \
+        --error_type virtual --num_cols 524288 --num_rows 5 --k 200000 \
+        --approx_topk --max_grad_norm 1 ;;
+    sub_clip1_r9) run gpt2_sketch24_sub_clip1_r9 --mode sketch \
+        --error_type virtual --num_cols 524288 --num_rows 9 --k 50000 \
         --approx_topk --sketch_ef subtract --max_grad_norm 1 ;;
     *) echo "unknown arm $arm"; exit 1 ;;
   esac
