@@ -216,6 +216,24 @@ class FedConfig:
     #   compression it trades the leak for residual decode noise left in
     #   the table, bounded per round by the (clipped) increment norm.
     sketch_ef: str = "zero"
+    # Where the server's momentum/error live in sketch mode (TPU-native
+    # extension; the reference always keeps them as (r, c) tables,
+    # fed_aggregator.py:568-613):
+    # - "table" (default): the reference's FetchSGD — all server state in
+    #   table space; EF per --sketch_ef.
+    # - "dense": momentum/error kept as dense (d,) pre-images; each round
+    #   ONE encode+decode round-trip of the error injects exactly the
+    #   compression noise the table channel imposes (the upload is still
+    #   the r x c table — byte accounting unchanged), and error feedback /
+    #   momentum masking zero the exact update support like true_topk.
+    #   Leak-free AND noise-dissipation-free-but-stable (state is exact),
+    #   at the cost of O(d) server memory — which the reference's PS
+    #   already spends on weights/velocities for every dense mode
+    #   (fed_aggregator.py:105-129). Single-device only (on a mesh it
+    #   would turn the table-sized psum back into a d-sized one);
+    #   requires deferred encode (no per-client table clip — use
+    #   --sketch_dense_clip for clipping).
+    sketch_server_state: str = "table"
     # Uniform table-space error decay (TPU-native extension): after the
     # round's error feedback, Verror *= error_decay (sketch and true_topk
     # modes). 1.0 = off. A blunt stabilizer for regimes where accumulated
@@ -288,6 +306,8 @@ class FedConfig:
         assert self.dp_mode in DP_MODES, self.dp_mode
         assert self.pallas in ("auto", "on", "off"), self.pallas
         assert self.sketch_ef in ("zero", "subtract"), self.sketch_ef
+        assert self.sketch_server_state in ("table", "dense"), \
+            self.sketch_server_state
         assert 0.0 < self.error_decay <= 1.0, self.error_decay
         if self.error_decay < 1.0:
             # silently ignoring the flag would let a decay study run
@@ -492,6 +512,13 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                    help="sketch error-feedback rule: zero = reference "
                         "cell-zeroing; subtract = remove exactly the "
                         "extracted estimates (no collateral cell loss)")
+    p.add_argument("--sketch_server_state", choices=("table", "dense"),
+                   default="table",
+                   help="sketch-mode server momentum/error: table = "
+                        "reference FetchSGD (r x c state); dense = (d,) "
+                        "pre-images with exact-support EF and one "
+                        "enc+dec noise round-trip (single device, "
+                        "deferred encode only; upload unchanged)")
     p.add_argument("--error_decay", type=float, default=1.0,
                    help="multiply Verror by this factor each round after "
                         "error feedback (sketch/true_topk); 1.0 = off")

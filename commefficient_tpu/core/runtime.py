@@ -207,8 +207,23 @@ class FedRuntime:
         # Single-device ONLY: on a mesh the pre-image trick would turn the
         # table-sized psum back into a d-sized dense psum — there the
         # per-shard encode + table-space subtractive rule applies instead.
+        # Always on for the SRHT transform (its dense transform admits no
+        # cell rule); opt-in for circ/hash via --sketch_server_state dense
+        # (round-5 study: the table-space rules either leak accumulated
+        # error [zero] or amplify decode noise [subtract] at GPT-2-scale
+        # collision load — the dense pre-image is leak-free AND stable,
+        # at O(d) server memory the reference's PS already spends on every
+        # dense mode).
         self._dense_preimage = (self._defer_encode and mesh is None
-                                and getattr(self.cs, "dense_transform", False))
+                                and (getattr(self.cs, "dense_transform",
+                                             False)
+                                     or cfg.sketch_server_state == "dense"))
+        if (cfg.mode == "sketch" and cfg.sketch_server_state == "dense"
+                and not self._dense_preimage):
+            raise ValueError(
+                "--sketch_server_state dense requires a single device "
+                "(no mesh) and deferred encode (no per-client table "
+                "clip — use --sketch_dense_clip to clip)")
 
         loss_fn_val = loss_fn_val if loss_fn_val is not None else loss_fn_train
         # Fused client gradients: when nothing nonlinear happens per client

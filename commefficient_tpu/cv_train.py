@@ -114,7 +114,11 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
                       + ("aligned1024" if (cfg.sketch_impl == "circ"
                                            and cfg.num_cols % 1024 == 0)
                          else "v1")
-                      + f"-{cfg.num_rows}x{cfg.num_cols}-{cfg.sketch_seed}")
+                      + f"-{cfg.num_rows}x{cfg.num_cols}-{cfg.sketch_seed}"
+                      # dense pre-image server state stores (d,) buffers,
+                      # not tables — a cross-state resume must refuse
+                      + ("-densestate"
+                         if cfg.sketch_server_state == "dense" else ""))
     mgr.default_meta = {"params_fingerprint": fp, "sketch_gen": sketch_gen}
     if cfg.do_resume:
         restored, meta = mgr.restore_latest(

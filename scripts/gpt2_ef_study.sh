@@ -45,6 +45,16 @@ for arm in "$@"; do
     sub_clip1_r9) run gpt2_sketch24_sub_clip1_r9 --mode sketch \
         --error_type virtual --num_cols 524288 --num_rows 9 --k 50000 \
         --approx_topk --sketch_ef subtract --max_grad_norm 1 ;;
+    densestate_clip1) run gpt2_sketch24_densestate_clip1 --mode sketch \
+        --error_type virtual --num_cols 524288 --num_rows 5 --k 50000 \
+        --approx_topk --sketch_server_state dense \
+        --sketch_dense_clip --max_grad_norm 1 ;;
+    densestate) run gpt2_sketch24_densestate --mode sketch \
+        --error_type virtual --num_cols 524288 --num_rows 5 --k 50000 \
+        --approx_topk --sketch_server_state dense ;;
+    sub_clip1_c1p8m) run gpt2_sketch24_sub_clip1_c1p8m --mode sketch \
+        --error_type virtual --num_cols 1835008 --num_rows 5 --k 50000 \
+        --approx_topk --sketch_ef subtract --max_grad_norm 1 ;;
     *) echo "unknown arm $arm"; exit 1 ;;
   esac
 done

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Attribute the ImageNet-recipe round's time op-by-op (VERDICT r4 weak #4).
+
+Same method as scripts/profile_gpt2_round.py (jax.profiler xplane trace,
+shared parser): FixupResNet50 @ 224x224, the reference's only tuned
+recipe (imagenet.sh: 7 workers x local batch 64, uncompressed, virtual
+momentum, iid). The committed narrative lives in
+runs/BREAKDOWN_imagenet.md; the binary trace dir is gitignored.
+
+Usage: python scripts/profile_imagenet_round.py [outdir] [--batch N]
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from profile_gpt2_round import group_of, parse_xplane  # noqa: E402
+
+
+def build_round(local_batch: int = 64):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from commefficient_tpu import models
+    from commefficient_tpu.config import FedConfig, enable_compilation_cache
+    from commefficient_tpu.core import FedRuntime
+    from commefficient_tpu.losses import make_cv_loss
+
+    W, B, HW = 7, local_batch, 224
+    cfg = FedConfig(mode="uncompressed", error_type="virtual",
+                    local_momentum=0.0, virtual_momentum=0.9,
+                    weight_decay=1e-4, num_workers=W, local_batch_size=B,
+                    num_clients=7, do_iid=True, track_bytes=False,
+                    num_results_train=2)
+    enable_compilation_cache(cfg)
+    model = models.FixupResNet50(num_classes=1000)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, HW, HW, 3), jnp.float32))
+    runtime = FedRuntime(cfg, params, make_cv_loss(model, "bfloat16"),
+                         num_clients=cfg.num_clients)
+    rng = np.random.RandomState(0)
+    batch = {"image": jnp.asarray(rng.randn(W, B, HW, HW, 3), jnp.float32),
+             "target": jnp.asarray(rng.randint(0, 1000, (W, B)), jnp.int32)}
+    args = (jnp.arange(W, dtype=jnp.int32), batch, jnp.ones((W, B), bool),
+            0.1)
+    return runtime, args, W * B
+
+
+def main():
+    argv = [a for a in sys.argv[1:]]
+    local_batch = 64
+    if "--batch" in argv:
+        i = argv.index("--batch")
+        local_batch = int(argv[i + 1])
+        del argv[i:i + 2]
+    outdir = argv[0] if argv else "runs/profile_imagenet"
+    os.makedirs(outdir, exist_ok=True)
+    import jax
+
+    runtime, args, imgs = build_round(local_batch)
+    state = runtime.init_state()
+    print("compiling + warmup...", flush=True)
+    t0 = time.time()
+    state, _ = runtime.round(state, *args)
+    jax.block_until_ready(state.ps_weights)
+    print(f"warmup {time.time() - t0:.1f}s", flush=True)
+
+    t0 = time.time()
+    with jax.profiler.trace(outdir):
+        for _ in range(3):
+            state, metrics = runtime.round(state, *args)
+        jax.block_until_ready(state.ps_weights)
+    wall = (time.time() - t0) / 3
+    print(f"traced 3 rounds, {wall * 1e3:.1f} ms/round wall "
+          f"({imgs / wall:.0f} img/s)", flush=True)
+
+    ops, span = parse_xplane(outdir)
+    if ops is None:
+        print("NO DEVICE TRACE CAPTURED — fall back to component ablation")
+        return
+    total = sum(ms for _, ms in ops)
+    print(f"\ndevice busy time {total / 3:.1f} ms/round "
+          f"(span {span / 3:.1f} ms/round)\n")
+    by_group = collections.Counter()
+    for name, ms in ops:
+        by_group[group_of(name)] += ms
+    print(f"{'group':28s} {'ms/round':>9s}  share")
+    for g, ms in by_group.most_common():
+        print(f"{g:28s} {ms / 3:9.2f}  {ms / total:6.1%}")
+    print("\ntop 40 ops (ms/round):")
+    for name, ms in ops[:40]:
+        print(f"  {ms / 3:8.2f}  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()

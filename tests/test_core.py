@@ -127,17 +127,21 @@ class TestGoldenTrajectories:
         for got, want in zip(traj_t, traj_u):
             np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
 
-    @pytest.mark.parametrize("impl", ["hash", "rht"])
-    def test_sketch_lossless_matches_true_topk(self, impl):
+    @pytest.mark.parametrize("impl,server_state", [
+        ("hash", "table"), ("rht", "table"),
+        ("hash", "dense"), ("circ", "dense")])
+    def test_sketch_lossless_matches_true_topk(self, impl, server_state):
         """Huge table => estimates are near-exact => FetchSGD reduces to
         true top-k (SURVEY.md §4 golden strategy). For the rht impl the
         lossless limit is exact by construction (c == padded size), which
         certifies the dense-preimage support-zeroing rule coincides with
-        the reference's cell-masking there (core/server.py)."""
+        the reference's cell-masking there (core/server.py); the
+        sketch_server_state=dense cases certify the same for the circ/hash
+        opt-in pre-image path."""
         d = D_FEAT + 1
         cfg_s = base_cfg(mode="sketch", error_type="virtual", k=d,
                          num_rows=7, num_cols=4096, num_blocks=1,
-                         sketch_impl=impl)
+                         sketch_impl=impl, sketch_server_state=server_state)
         _, _, traj_s, _ = run_rounds(cfg_s, 5)
         _, _, traj_u, _ = run_rounds(base_cfg(), 5)
         for got, want in zip(traj_s, traj_u):
