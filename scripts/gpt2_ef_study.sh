@@ -55,6 +55,13 @@ for arm in "$@"; do
     sub_clip1_c1p8m) run gpt2_sketch24_sub_clip1_c1p8m --mode sketch \
         --error_type virtual --num_cols 1835008 --num_rows 5 --k 50000 \
         --approx_topk --sketch_ef subtract --max_grad_norm 1 ;;
+    clip1_c1p8m) run gpt2_sketch24_clip1_c1p8m --mode sketch \
+        --error_type virtual --num_cols 1835008 --num_rows 5 --k 50000 \
+        --approx_topk --max_grad_norm 1 ;;
+    densestate_clip1_decay95) run gpt2_sketch24_densestate_clip1_decay95 \
+        --mode sketch --error_type virtual --num_cols 524288 --num_rows 5 \
+        --k 50000 --approx_topk --sketch_server_state dense \
+        --sketch_dense_clip --max_grad_norm 1 --error_decay 0.95 ;;
     *) echo "unknown arm $arm"; exit 1 ;;
   esac
 done

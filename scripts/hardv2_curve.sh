@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Accuracy-vs-compression curve at a fixed 48-epoch budget on the
+# hard-v2 regime (VERDICT r4 next-round #6: the BASELINE.json metric is
+# time-to-accuracy vs grad-compression ratio, and the tree had single
+# points, no curve). Sketch width sweep c in {0.5M, 1M, 2M, 4M, 8M}
+# (d = 6.57M, r = 5 => compression 2.6x..0.16x of d at the wide end)
+# under the reference zero-EF rule, plus the round-5 subtract-EF rule at
+# the flagship width, against the committed anchors
+# (cifar10_hard48v2_{uncompressed,true_topk,sketch}.tsv).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+run() {
+    local name=$1; shift
+    echo "=== $name ==="
+    python cv_train.py --dataset_name CIFAR10 --model ResNet9 --batchnorm \
+      --iid --num_clients 40 --num_workers 8 --local_batch_size 64 \
+      --num_epochs 48 --synthetic_per_class 400 --synthetic_hard \
+      --synthetic_label_noise 0.08 --lr_scale 0.1 --seed 21 \
+      --local_momentum 0.0 --virtual_momentum 0.9 \
+      --mode sketch --error_type virtual \
+      --k 50000 --num_rows 5 --num_blocks 20 --approx_topk --exact_num_cols \
+      "$@" 2>&1 | tee "runs/$name.log"
+    { echo "epoch,hours,top1Accuracy";
+      grep -E "^[0-9]+,0\.[0-9]+,[0-9.]+$" "runs/$name.log"; } \
+      > "runs/$name.tsv"
+    tail -1 "runs/$name.tsv"
+}
+
+for arm in "$@"; do
+  case "$arm" in
+    c1m)  run cifar10_hard48v2_sketch_c1m  --num_cols 1000000 ;;
+    c2m)  run cifar10_hard48v2_sketch_c2m  --num_cols 2000000 ;;
+    c4m)  run cifar10_hard48v2_sketch_c4m  --num_cols 4000000 ;;
+    c8m)  run cifar10_hard48v2_sketch_c8m  --num_cols 8000000 ;;
+    c2m_sub) run cifar10_hard48v2_sketch_c2m_sub --num_cols 2000000 \
+        --sketch_ef subtract ;;
+    *) echo "unknown arm $arm"; exit 1 ;;
+  esac
+done
+echo CURVE_DONE

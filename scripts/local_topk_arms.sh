@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# local_topk operating-regime confirmation on TPU (VERDICT r4 next-round
+# #2): scripts/local_topk_sim.py's CPU sweep of the REFERENCE dynamics
+# says local error feedback diverges at real compression unless lr is
+# cut far below the dense-stable value, and error_type none tolerates
+# ~10x more lr. These arms confirm on the hard-v2 CV regime.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+run() {
+    local name=$1; shift
+    echo "=== $name ==="
+    python cv_train.py --dataset_name CIFAR10 --model ResNet9 --batchnorm \
+      --iid --num_clients 40 --num_workers 8 --local_batch_size 64 \
+      --num_epochs 24 --synthetic_per_class 400 --synthetic_hard \
+      --synthetic_label_noise 0.08 --seed 21 \
+      --virtual_momentum 0.9 --mode local_topk --k 50000 --approx_topk \
+      "$@" 2>&1 | tee "runs/$name.log"
+    { echo "epoch,hours,top1Accuracy";
+      grep -E "^[0-9]+,0\.[0-9]+,[0-9.]+$" "runs/$name.log"; } \
+      > "runs/$name.tsv"
+    tail -1 "runs/$name.tsv"
+}
+
+for arm in "$@"; do
+  case "$arm" in
+    lr01)  run cifar10_hard24v2_local_topk_lr01 \
+        --error_type local --local_momentum 0.0 --lr_scale 0.01 ;;
+    lr003) run cifar10_hard24v2_local_topk_lr003 \
+        --error_type local --local_momentum 0.0 --lr_scale 0.003 ;;
+    efnone) run cifar10_hard24v2_local_topk_efnone \
+        --error_type none --local_momentum 0.0 --lr_scale 0.1 ;;
+    *) echo "unknown arm $arm"; exit 1 ;;
+  esac
+done
+echo LOCAL_TOPK_DONE
