@@ -58,6 +58,15 @@ for arm in "$@"; do
     clip1_c1p8m) run gpt2_sketch24_clip1_c1p8m --mode sketch \
         --error_type virtual --num_cols 1835008 --num_rows 5 --k 50000 \
         --approx_topk --max_grad_norm 1 ;;
+    clip1_c4m) run gpt2_sketch24_clip1_c4m --mode sketch \
+        --error_type virtual --num_cols 4194304 --num_rows 5 --k 50000 \
+        --approx_topk --max_grad_norm 1 ;;
+    clip1_c8m) run gpt2_sketch24_clip1_c8m --mode sketch \
+        --error_type virtual --num_cols 8388608 --num_rows 5 --k 50000 \
+        --approx_topk --max_grad_norm 1 ;;
+    clip1_r9) run gpt2_sketch24_clip1_r9 --mode sketch \
+        --error_type virtual --num_cols 524288 --num_rows 9 --k 50000 \
+        --approx_topk --max_grad_norm 1 ;;
     densestate_clip1_decay95) run gpt2_sketch24_densestate_clip1_decay95 \
         --mode sketch --error_type virtual --num_cols 524288 --num_rows 5 \
         --k 50000 --approx_topk --sketch_server_state dense \
