@@ -116,6 +116,11 @@ class FedConfig:
     error_type: str = "none"
     lr_scale: Optional[float] = 0.4
     pivot_epoch: float = 5.0
+    # GPT-2 LR warmup (TPU-native opt-in; the reference's GPT-2 schedule
+    # is linear -> 0 from full LR at step 0): ramp 0 -> lr_scale over
+    # pivot_epoch, then linear -> 0. The CV driver always ramps (its
+    # reference does); this flag only affects gpt2_train.
+    lr_warmup: bool = False
 
     # federation / parallelization
     num_clients: Optional[int] = None
@@ -462,6 +467,10 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--error_type", choices=ERROR_TYPES, default="none")
     p.add_argument("--lr_scale", type=float, default=default_lr)
     p.add_argument("--pivot_epoch", type=float, default=5)
+    p.add_argument("--lr_warmup", action="store_true",
+                   help="GPT-2 only: linear 0 -> lr_scale warmup peaking "
+                        "at --pivot_epoch (the reference starts at full "
+                        "LR; see gpt2_train.make_gpt2_schedule)")
 
     p.add_argument("--num_clients", type=int)
     p.add_argument("--num_workers", type=int, default=1)

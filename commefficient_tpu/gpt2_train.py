@@ -64,9 +64,18 @@ def build_gpt2(cfg: FedConfig, tokenizer):
 
 def make_gpt2_schedule(cfg: FedConfig):
     """Reference GPT-2 LR trajectory: LINEAR lr -> 0 from step 0
-    (gpt2_train.py:302-307) — not the CV triangular ramp."""
+    (gpt2_train.py:302-307) — not the CV triangular ramp. ``--lr_warmup``
+    (TPU-native opt-in; the reference has no GPT-2 warmup) prepends a
+    linear 0 -> lr ramp peaking at ``--pivot_epoch``, giving GPT-2 the CV
+    driver's triangular shape — a stabilizer arm of the round-5 sketch
+    study (from-scratch GPT-2 under plain SGD diverges unclipped;
+    warmup is the classical alternative to clipping)."""
     from commefficient_tpu.utils import PiecewiseLinear
     lr0 = cfg.lr_scale if cfg.lr_scale is not None else 0.16
+    if cfg.lr_warmup:
+        pivot = min(float(cfg.pivot_epoch), float(cfg.num_epochs))
+        return PiecewiseLinear([0.0, pivot, float(cfg.num_epochs)],
+                               [0.0, lr0, 0.0])
     return PiecewiseLinear([0.0, float(cfg.num_epochs)], [lr0, 0.0])
 
 

@@ -67,6 +67,11 @@ for arm in "$@"; do
     clip1_r9) run gpt2_sketch24_clip1_r9 --mode sketch \
         --error_type virtual --num_cols 524288 --num_rows 9 --k 50000 \
         --approx_topk --max_grad_norm 1 ;;
+    warmup) run gpt2_sketch24_warmup --mode sketch \
+        --error_type virtual --num_cols 524288 --num_rows 5 --k 50000 \
+        --approx_topk --lr_warmup --pivot_epoch 3 ;;
+    uncompressed_warmup) run gpt2_uncompressed24_warmup \
+        --mode uncompressed --error_type none --lr_warmup --pivot_epoch 3 ;;
     densestate_clip1_decay95) run gpt2_sketch24_densestate_clip1_decay95 \
         --mode sketch --error_type virtual --num_cols 524288 --num_rows 5 \
         --k 50000 --approx_topk --sketch_server_state dense \

@@ -242,6 +242,13 @@ def test_gpt2_lr_schedule_is_linear_to_zero():
     assert abs(s(1.0) - 0.12) < 1e-9      # linear
     assert abs(s(2.0) - 0.08) < 1e-9
     assert s(4.0) == 0.0
+    # --lr_warmup (TPU-native opt-in): triangular 0 -> lr -> 0
+    w = make_gpt2_schedule(cfg.replace(lr_warmup=True, pivot_epoch=1.0))
+    assert w(0.0) == 0.0
+    assert abs(w(0.5) - 0.08) < 1e-9
+    assert w(1.0) == 0.16
+    assert abs(w(2.5) - 0.08) < 1e-9
+    assert w(4.0) == 0.0
 
 
 def test_save_pretrained_roundtrip(tmp_path):
