@@ -67,6 +67,9 @@ for arm in "$@"; do
     clip1_r9) run gpt2_sketch24_clip1_r9 --mode sketch \
         --error_type virtual --num_cols 524288 --num_rows 9 --k 50000 \
         --approx_topk --max_grad_norm 1 ;;
+    clip1_r2_c4p6m) run gpt2_sketch24_clip1_r2_c4p6m --mode sketch \
+        --error_type virtual --num_cols 4603904 --num_rows 2 --k 50000 \
+        --approx_topk --max_grad_norm 1 ;;
     warmup) run gpt2_sketch24_warmup --mode sketch \
         --error_type virtual --num_cols 524288 --num_rows 5 --k 50000 \
         --approx_topk --lr_warmup --pivot_epoch 3 ;;
