@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Bare-model FixupResNet50 @ 224x224 fwd+bwd MFU probe (VERDICT r4 weak
+#4): isolates the MODEL's conv efficiency from the federated round so
+the round's MFU gap decomposes into (model ceiling) + (federated
+overhead). Also profiles per-op so the stem/input-layout cost is named.
+
+Usage: python scripts/bench_imagenet_model.py [--batch N] [--s2d]
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from profile_gpt2_round import parse_xplane  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench_common import peak_flops
+    from commefficient_tpu import models
+    from commefficient_tpu.losses import make_cv_loss
+
+    B = 64
+    if "--batch" in sys.argv:
+        B = int(sys.argv[sys.argv.index("--batch") + 1])
+    use_s2d = "--s2d" in sys.argv
+
+    model = models.FixupResNet50(num_classes=1000, space_to_depth=use_s2d) \
+        if use_s2d else models.FixupResNet50(num_classes=1000)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 224, 224, 3), jnp.float32))
+    loss_fn = make_cv_loss(model, "bfloat16")
+    rng = np.random.RandomState(0)
+    batch = {"image": jnp.asarray(rng.randn(B, 224, 224, 3), jnp.float32),
+             "target": jnp.asarray(rng.randint(0, 1000, (B,)), jnp.int32)}
+    mask = jnp.ones((B,), bool)
+
+    g = jax.jit(jax.value_and_grad(lambda p: loss_fn(p, batch, mask)[0]))
+    print("compiling...", flush=True)
+    out = g(params)
+    jax.block_until_ready(out)
+    n = 10
+    t0 = time.time()
+    for _ in range(n):
+        out = g(params)
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / n
+    flops = 3 * 4.1e9 * B
+    peak = peak_flops(jax.devices()[0])
+    print(f"batch {B}{' s2d' if use_s2d else ''}: {dt*1e3:.1f} ms/step, "
+          f"{B/dt:.0f} img/s, MFU {flops/dt/peak:.1%}", flush=True)
+
+    outdir = "/tmp/profile_imagenet_model"
+    with jax.profiler.trace(outdir):
+        for _ in range(3):
+            out = g(params)
+        jax.block_until_ready(out)
+    ops, span = parse_xplane(outdir)
+    if ops:
+        print(f"span {span/3:.1f} ms/step; top 25 ops (ms/step):")
+        for name, ms in ops[:25]:
+            print(f"  {ms/3:8.2f}  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()
