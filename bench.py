@@ -27,6 +27,7 @@ never the artifact.
 
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 import traceback
@@ -39,7 +40,7 @@ NOMINAL_SINGLE_GPU_IMG_PER_SEC = 2000.0
 
 
 def run_cifar(result: dict, W: int = 8, B: int = 64,
-              n_rounds: int = 20) -> None:
+              n_rounds: int = 20, telemetry=None, profiler=None) -> None:
     """Fill ``result`` in place so partial progress survives a crash.
 
     Default (W=8, B=64) is the flagship-parity round shape — 512
@@ -78,6 +79,11 @@ def run_cifar(result: dict, W: int = 8, B: int = 64,
     loss_fn = make_cv_loss(model, "bfloat16")
 
     runtime = FedRuntime(cfg, params, loss_fn, num_clients=cfg.num_clients)
+    if telemetry is not None:
+        # compile events (lower/compile wall time + cost-analysis FLOPs)
+        # for the warmup's compiles land in the shared stream
+        telemetry.instrument(runtime)
+        telemetry.memory_event(f"cifar_w{W}_b{B}_init")
 
     rng = np.random.RandomState(0)
     batch = {
@@ -89,7 +95,8 @@ def run_cifar(result: dict, W: int = 8, B: int = 64,
     lr = 0.1
 
     dt, metrics = timed_rounds(runtime, (client_ids, batch, mask, lr),
-                               warmup=2, rounds=n_rounds, desc="cifar")
+                               warmup=2, rounds=n_rounds, desc="cifar",
+                               profiler=profiler)
 
     images = n_rounds * W * B
     ips = images / dt
@@ -99,6 +106,7 @@ def run_cifar(result: dict, W: int = 8, B: int = 64,
 
     result["value"] = round(ips, 1)
     result["vs_baseline"] = round(ips / NOMINAL_SINGLE_GPU_IMG_PER_SEC, 3)
+    result["timed_rounds"] = n_rounds
 
     # MFU numerator = MODEL FLOPs (the ResNet-9 fwd+bwd for the round's
     # W*B images, from XLA's cost analysis of the bare value_and_grad — no
@@ -123,9 +131,44 @@ def run_cifar(result: dict, W: int = 8, B: int = 64,
     mfu = (flops * n_rounds / dt) / peak
     log(f"model FLOPs/round {flops:.3e}, peak {peak:.0f}, MFU {mfu:.3f}")
     result["mfu"] = round(mfu, 4) if np.isfinite(mfu) else None
+    if telemetry is not None:
+        telemetry.bench_event(result["metric"], result)
 
 
-def main():
+def make_bench_telemetry(args, run_type: str):
+    """Shared bench CLI: ``--telemetry_dir`` opens the same JSONL stream
+    the drivers write (telemetry/schema.py); ``--profile_dir``/
+    ``--profile_rounds`` place a jax trace over the timed rounds."""
+    from commefficient_tpu.telemetry import ProfilerWindow, RunTelemetry
+    telemetry = None
+    if args.telemetry_dir:
+        telemetry = RunTelemetry(args.telemetry_dir, run_type)
+        if telemetry.active:
+            log(f"telemetry: {telemetry.path}")
+        else:
+            telemetry = None  # constructor warned; no stream to feed
+    profiler = (ProfilerWindow(args.profile_dir, args.profile_rounds,
+                               log=log)
+                if args.profile_dir else None)
+    return telemetry, profiler
+
+
+def add_bench_args(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--telemetry_dir", default="",
+                    help="write a telemetry.jsonl event stream here "
+                         "(same schema as the drivers')")
+    ap.add_argument("--profile_dir", default="",
+                    help="write a jax profiler trace of the timed rounds")
+    ap.add_argument("--profile_rounds", default="2:4",
+                    help="1-based inclusive timed-round window for the "
+                         "trace, START:STOP")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    add_bench_args(ap)
+    args = ap.parse_args(argv)
+    telemetry, profiler = make_bench_telemetry(args, "bench")
     result = {
         "metric": "cifar10_sketch_round_throughput",
         "value": None,
@@ -134,7 +177,7 @@ def main():
         "mfu": None,
     }
     try:
-        run_cifar(result)
+        run_cifar(result, telemetry=telemetry, profiler=profiler)
     except Exception as e:
         log(traceback.format_exc())
         result["error"] = f"{type(e).__name__}: {e}"
@@ -154,7 +197,7 @@ def main():
         sat = {"metric": "cifar10_sketch_round_throughput_saturated",
                "value": None, "unit": "images/sec", "vs_baseline": None,
                "mfu": None, "round_images": 32 * 512}
-        run_cifar(sat, W=32, B=512, n_rounds=10)
+        run_cifar(sat, W=32, B=512, n_rounds=10, telemetry=telemetry)
         result["cifar_saturated"] = sat
         log("saturated:", json.dumps(sat))
     except Exception as e:
@@ -167,11 +210,20 @@ def main():
     # chip, and vice versa)
     try:
         import bench_gpt2
-        result["gpt2"] = bench_gpt2.run()
+        result["gpt2"] = bench_gpt2.run(telemetry=telemetry)
     except Exception as e:
         log(traceback.format_exc())
         log(f"WARNING: GPT-2 bench failed ({e})")
         result["gpt2"] = {"error": f"{type(e).__name__}: {e}"}
+    if telemetry is not None:
+        # total timed rounds across the stages that actually ran
+        n_rounds = sum(
+            stage.get("timed_rounds", 0)
+            for stage in (result, result.get("cifar_saturated") or {},
+                          result.get("gpt2") or {}))
+        telemetry.write_summary(aborted="error" in result,
+                                n_rounds=n_rounds, final=result)
+        telemetry.close()
     print(json.dumps(result))
     # rc=0 iff the headline number exists; partial JSON is emitted either way
     sys.exit(0 if result["value"] is not None else 1)

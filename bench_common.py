@@ -86,8 +86,15 @@ def with_retries(fn, *, desc: str, tries: int = 4, base_delay: float = 5.0):
             time.sleep(delay)
 
 
-def timed_rounds(runtime, round_args, *, warmup, rounds, desc: str):
+def timed_rounds(runtime, round_args, *, warmup, rounds, desc: str,
+                 profiler=None):
     """Donation-safe, retry-wrapped warmup + timing of federated rounds.
+
+    ``profiler`` (telemetry.ProfilerWindow) places a jax trace over the
+    TIMED rounds, numbered 1..rounds — the warmup (and its compile) stays
+    out of the trace. Profiling syncs the device inside the loop, so a
+    profiled attempt's timing is not a clean throughput number; pass a
+    profiler only when the trace is the point of the run.
 
     The round step DONATES its input state, so a retry must never reuse a
     state object a failed attempt already fed in: the warmup attempt body
@@ -128,8 +135,24 @@ def timed_rounds(runtime, round_args, *, warmup, rounds, desc: str):
         s = jax.tree.map(jnp.asarray, host_state)
         jax.block_until_ready(s)
         t0 = time.time()
-        for _ in range(rounds):
-            s, m = runtime.round(s, *round_args)
+        try:
+            for i in range(rounds):
+                if profiler is not None:
+                    profiler.maybe_start(i + 1)
+                s, m = runtime.round(s, *round_args)
+                if profiler is not None:
+                    profiler.maybe_stop(
+                        i + 1, lambda: jax.block_until_ready(s.ps_weights))
+        except BaseException:
+            # a retried attempt must not leak an open trace into the
+            # profiler's process-global state
+            if profiler is not None:
+                profiler.abort()
+            raise
+        if profiler is not None:
+            # window STOP beyond the timed round count: keep the partial
+            # trace instead of leaking the open profiler
+            profiler.finalize(lambda: jax.block_until_ready(s.ps_weights))
         float(s.ps_weights[0])
         return time.time() - t0, m
 

@@ -59,7 +59,7 @@ def gpt2_model_flops(gcfg, tokens: int, S: int) -> float:
     return 3.0 * fwd_per_tok * tokens
 
 
-def run(remat: bool = True) -> dict:
+def run(remat: bool = True, telemetry=None, profiler=None) -> dict:
     """Build, warm up and time the GPT-2 round; returns the result dict.
 
     ``remat=True`` is the shipping configuration. remat=False spends the
@@ -104,12 +104,18 @@ def run(remat: bool = True) -> dict:
     runtime = FedRuntime(cfg, params,
                          make_gpt2_train_loss(model, lm_chunk=cfg.lm_chunk),
                          num_clients=cfg.num_clients)
+    if telemetry is not None:
+        # the ~10-20 min cold compile of this round becomes a visible
+        # compile event (wall time + cost analysis) in the shared stream
+        telemetry.instrument(runtime)
+        telemetry.memory_event("gpt2_init")
     mask = jnp.ones((W, B), bool)
     ids = jnp.arange(W, dtype=jnp.int32)
 
     n_rounds = 8
     dt, metrics = timed_rounds(runtime, (ids, batch, mask, 0.1),
-                               warmup=1, rounds=n_rounds, desc="gpt2")
+                               warmup=1, rounds=n_rounds, desc="gpt2",
+                               profiler=profiler)
 
     toks = n_rounds * W * B * NC * S
     tps = toks / dt
@@ -122,18 +128,35 @@ def run(remat: bool = True) -> dict:
     mfu = (flops * n_rounds / dt) / peak
     log(f"{n_rounds} rounds in {dt:.3f}s -> {tps:.0f} tok/s, loss {loss:.3f}")
     log(f"model FLOPs/round {flops:.3e}, peak {peak:.0f}, MFU {mfu:.3f}")
-    return {
+    result = {
         "metric": "gpt2_sketch_round_throughput",
         "value": round(tps, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(tps / NOMINAL_SINGLE_GPU_TOK_PER_SEC, 3),
         "mfu": round(mfu, 4) if np.isfinite(mfu) else None,
         "tokens_per_round": W * B * NC * S,
+        "timed_rounds": n_rounds,
     }
+    if telemetry is not None:
+        telemetry.bench_event(result["metric"], result)
+    return result
 
 
-def main():
-    print(json.dumps(run()))
+def main(argv=None):
+    import argparse
+
+    from bench import add_bench_args, make_bench_telemetry
+    ap = argparse.ArgumentParser(description=__doc__)
+    add_bench_args(ap)
+    args = ap.parse_args(argv)
+    telemetry, profiler = make_bench_telemetry(args, "bench_gpt2")
+    result = run(telemetry=telemetry, profiler=profiler)
+    if telemetry is not None:
+        telemetry.write_summary(aborted=False,
+                                n_rounds=result["timed_rounds"],
+                                final=result)
+        telemetry.close()
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

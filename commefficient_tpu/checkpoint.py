@@ -50,6 +50,10 @@ def params_fingerprint(params) -> str:
 DEFAULT_MAX_HOST_BYTES = int(os.environ.get(
     "COMMEFFICIENT_CKPT_MAX_HOST_BYTES", 8 << 30))
 
+# "no sketch-generation check requested" sentinel for restore_latest
+# (None is a meaningful value there: a non-sketch restoring run)
+_UNSET = object()
+
 
 def _state_nbytes(state: FedState) -> int:
     return sum(getattr(state, name).nbytes for name in _FIELDS
@@ -463,18 +467,37 @@ class CheckpointManager:
 
     def restore_latest(self, sharding=None, expect_fingerprint=None,
                        allow_missing_fingerprint=False, d_pad=None,
-                       num_clients=None, d_row_pad=None):
+                       num_clients=None, d_row_pad=None,
+                       expect_sketch_gen=_UNSET,
+                       sketch_mismatch_ok=False):
         """Returns (state, meta) or (None, {}). When the caller carries a
         params fingerprint, a mismatch — or a checkpoint that predates
         fingerprinting and so carries none — raises instead of resuming into
         a possibly scrambled flat-weight layout (a pre-fingerprint GPT-2
         checkpoint resumed after e.g. ``scan_layers`` flipped would reorder
         the whole ravel silently). ``allow_missing_fingerprint=True`` opts
-        back in to loading un-fingerprinted checkpoints."""
+        back in to loading un-fingerprinted checkpoints.
+
+        ``expect_sketch_gen`` (the restoring run's sketch-generation
+        marker, see cv_train.setup_checkpointing; pass None for non-sketch
+        runs) is checked against the checkpoint's meta BEFORE any state is
+        materialized: a marker mismatch raises the explanatory error here
+        — in particular, a table-state checkpoint resumed under
+        ``sketch_server_state='dense'`` (or vice versa) must fail with the
+        layout explanation, not with the raw array-shape error the load
+        itself would hit. ``sketch_mismatch_ok=True`` (drivers:
+        --resume_unverified) downgrades SAME-layout marker mismatches to
+        the caller's discard-and-continue path; cross-layout mismatches
+        still raise (there is no state to discard INTO — the saved tables
+        and the runtime's pre-images do not even have the same shape)."""
         e = self.latest()
         if e is None:
             return None, {}
         meta = load_meta(self._path(e))
+        if expect_sketch_gen is not _UNSET and expect_sketch_gen is not None:
+            self._check_sketch_gen(meta.get("sketch_gen"),
+                                   expect_sketch_gen, sketch_mismatch_ok,
+                                   self._path(e))
         saved_fp = meta.get("params_fingerprint")
         if expect_fingerprint is not None:
             if saved_fp is None and not allow_missing_fingerprint:
@@ -495,3 +518,52 @@ class CheckpointManager:
         return load_state(self._path(e), sharding=sharding, d_pad=d_pad,
                           num_clients=num_clients,
                           d_row_pad=d_row_pad), meta
+
+    @staticmethod
+    def _check_sketch_gen(saved_gen, expect_gen: str, mismatch_ok: bool,
+                          path: str) -> None:
+        """Sketch state (momentum/error tables or dense pre-images) only
+        decodes under the EXACT construction that encoded it; see the
+        marker format in cv_train.setup_checkpointing."""
+        if saved_gen == expect_gen:
+            return
+        # server-state LAYOUT first: "-densestate" markers store (d,)
+        # pre-image buffers, table markers store (r, c) tables (and
+        # pre-marker checkpoints predate the dense path entirely) — no
+        # discard can cross layouts, so --resume_unverified cannot help
+        dense_saved = (isinstance(saved_gen, str)
+                       and saved_gen.endswith("-densestate"))
+        dense_want = expect_gen.endswith("-densestate")
+        if dense_saved != dense_want:
+            saved_layout = "dense (d,) pre-images" if dense_saved \
+                else "(r, c) tables"
+            want_layout = "dense (d,) pre-images" if dense_want \
+                else "(r, c) tables"
+            raise ValueError(
+                f"checkpoint {path} stores its sketch server state as "
+                f"{saved_layout} (generation {saved_gen!r}) but this run "
+                f"uses {want_layout} (generation {expect_gen!r}): the "
+                "saved momentum/error state does not even have this "
+                "run's shapes, so it cannot be loaded OR discarded in "
+                "place. Re-create the run, or restore under the "
+                "original --sketch_server_state.")
+        if mismatch_ok:
+            return  # caller discards the sketch state and keeps weights
+        if saved_gen is None:
+            # pre-marker checkpoints are UNVERIFIABLE, not known-
+            # mismatched: that era could write any sketch_impl/seed with
+            # the same (r, c) shapes, so the tables may or may not decode
+            # correctly — refuse with wording that says so
+            raise ValueError(
+                f"checkpoint {path} predates sketch-generation markers, "
+                "so its momentum/error tables cannot be verified against "
+                f"the current construction {expect_gen!r} (the writing "
+                "run's sketch_impl/seed were not recorded). Pass "
+                "--resume_unverified to DISCARD the sketch state and "
+                "continue from the weights.")
+        raise ValueError(
+            f"checkpoint sketch generation {saved_gen!r} does not match "
+            f"the current construction {expect_gen!r}: the saved "
+            "momentum/error tables would decode under the wrong shifts. "
+            "Re-create the run, or pass --resume_unverified to DISCARD "
+            "the sketch state and continue from the weights.")

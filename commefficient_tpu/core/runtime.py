@@ -27,7 +27,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from commefficient_tpu.config import FedConfig
@@ -36,6 +36,7 @@ from commefficient_tpu.core.server import server_update, validate_mode_combo
 from commefficient_tpu.core.state import FedState
 from commefficient_tpu.ops import ravel_params
 from commefficient_tpu.ops.sketch import make_sketch_impl
+from commefficient_tpu.utils.jax_compat import shard_map
 
 
 class FedRuntime:
@@ -283,6 +284,20 @@ class FedRuntime:
             self._val = jax.jit(self._val_step_sharded)
         else:
             self._val = jax.jit(self._val_step)
+
+    def set_compile_watcher(self, watcher) -> None:
+        """Compile observability hook (telemetry.JitWatcher): wraps the
+        jitted round/val steps so every lowering+compile — including
+        recompiles from shape changes or donation misses — is timed,
+        cost-analyzed and logged instead of stalling silently. Call
+        before the first round. A repeat call is a no-op: the wrapper
+        needs the raw jitted functions' AOT surface, so double-wrapping
+        would silently break the observation it exists to provide."""
+        if getattr(self, "_compile_watched", False):
+            return
+        self._compile_watched = True
+        self._round = watcher.wrap("round_step", self._round)
+        self._val = watcher.wrap("val_step", self._val)
 
     def _probe_seq_grad_scale(self) -> float:
         """Measure how the round's cross-seq-shard gradient sum over-counts

@@ -70,6 +70,29 @@ def validate_mode_combo(cfg: FedConfig) -> None:
                     msg + ". Pass --allow_divergent_rht to proceed anyway.")
             import sys
             print(f"WARNING: {msg}", file=sys.stderr)
+        if cfg.sketch_ef == "subtract" and (
+                cfg.sketch_server_state == "dense"
+                or cfg.sketch_impl == "rht"):
+            # the dense-preimage server path (forced for rht's dense
+            # transform, opt-in via --sketch_server_state dense) keeps
+            # momentum/error as exact (d,) pre-images and zeroes them at
+            # the update support — it has no table cells, so neither
+            # table-space EF rule applies and the requested subtract rule
+            # would be SILENTLY ignored (ADVICE.md). An EF study arm run
+            # through this path would measure the wrong rule; fail fast.
+            which = ("sketch_server_state=dense"
+                     if cfg.sketch_server_state == "dense"
+                     else "sketch_impl=rht (its dense transform admits no "
+                          "table-cell rule)")
+            raise ValueError(
+                f"--sketch_ef subtract has no effect with {which}: that "
+                "server path applies its own error-feedback rule (exact "
+                "support zeroing on dense pre-images / the estimate-space "
+                "equivalent) and would silently ignore the requested "
+                "table-space subtract. Drop --sketch_ef subtract (these "
+                "paths are already leak-free), or use sketch_impl=circ/"
+                "hash with sketch_server_state=table to study the "
+                "subtract rule.")
         if e != "virtual":
             raise ValueError(
                 "mode=sketch requires error_type=virtual (FetchSGD). "

@@ -15,6 +15,7 @@ from __future__ import annotations
 import math
 import os
 import sys
+import time
 from typing import Optional
 
 import jax
@@ -33,6 +34,8 @@ from commefficient_tpu.data import (
 )
 from commefficient_tpu.data.device_store import make_device_store
 from commefficient_tpu.losses import make_cv_loss
+from commefficient_tpu.telemetry import ProfilerWindow
+from commefficient_tpu.telemetry import maybe_create as make_telemetry
 from commefficient_tpu.utils import (
     PiecewiseLinear,
     TableLogger,
@@ -121,39 +124,25 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
                          if cfg.sketch_server_state == "dense" else ""))
     mgr.default_meta = {"params_fingerprint": fp, "sketch_gen": sketch_gen}
     if cfg.do_resume:
+        # the sketch-generation marker is checked against the checkpoint's
+        # META (inside restore_latest) BEFORE any state is materialized —
+        # in particular a table-state checkpoint resumed under
+        # --sketch_server_state dense fails with the layout explanation
+        # instead of a raw array-shape error mid-load
         restored, meta = mgr.restore_latest(
             sharding=runtime._state_sharding, expect_fingerprint=fp,
             allow_missing_fingerprint=cfg.resume_unverified,
             d_pad=runtime.d_pad, num_clients=runtime.num_clients,
-            d_row_pad=runtime.d_row_pad)
+            d_row_pad=runtime.d_row_pad,
+            expect_sketch_gen=sketch_gen,
+            sketch_mismatch_ok=cfg.resume_unverified)
         if restored is not None:
             saved_gen = meta.get("sketch_gen")
             if saved_gen != sketch_gen and sketch_gen is not None:
-                if not cfg.resume_unverified:
-                    if saved_gen is None:
-                        # pre-marker checkpoints are UNVERIFIABLE, not
-                        # known-mismatched: that era could write any
-                        # sketch_impl/seed with the same (r, c) shapes,
-                        # so the tables may or may not decode correctly —
-                        # refuse with wording that says so
-                        raise ValueError(
-                            "checkpoint predates sketch-generation "
-                            "markers, so its momentum/error tables "
-                            "cannot be verified against the current "
-                            f"construction {sketch_gen!r} (the writing "
-                            "run's sketch_impl/seed were not recorded). "
-                            "Pass --resume_unverified to DISCARD the "
-                            "sketch state and continue from the weights.")
-                    raise ValueError(
-                        f"checkpoint sketch generation {saved_gen!r} does "
-                        f"not match the current construction "
-                        f"{sketch_gen!r}: the saved momentum/error tables "
-                        "would decode under the wrong shifts. Re-create "
-                        "the run, or pass --resume_unverified to DISCARD "
-                        "the sketch state and continue from the weights.")
-                # discard-and-continue: fresh tables, weights kept —
-                # resuming with mismatched tables would silently decode
-                # garbage every round
+                # only reachable under --resume_unverified (same-layout
+                # mismatch). Discard-and-continue: fresh tables, weights
+                # kept — resuming with mismatched tables would silently
+                # decode garbage every round
                 restored = restored.replace(
                     Vvelocity=jnp.zeros_like(restored.Vvelocity),
                     Verror=jnp.zeros_like(restored.Verror))
@@ -234,9 +223,12 @@ def run_validation(runtime: FedRuntime, state, val_ds, cfg: FedConfig,
     return float(host_sums[0]) / total, float(host_sums[1]) / total
 
 
-def make_writer(cfg: FedConfig):
+def make_writer(cfg: FedConfig, logdir: Optional[str] = None):
     """TensorBoard writer when --tensorboard is set (reference utils.py:51-64
-    + cv_train.py:407-411); gated on torch's SummaryWriter being available."""
+    + cv_train.py:407-411); gated on torch's SummaryWriter being available.
+    ``logdir`` shares the run directory with telemetry — make_logdir
+    timestamps at second resolution, so two independent calls can split
+    one run's artifacts across sibling directories."""
     if not cfg.use_tensorboard:
         return None
     try:
@@ -244,13 +236,18 @@ def make_writer(cfg: FedConfig):
     except Exception:
         print("WARNING: --tensorboard set but SummaryWriter unavailable")
         return None
-    return SummaryWriter(log_dir=make_logdir(cfg))
+    return SummaryWriter(log_dir=logdir or make_logdir(cfg))
 
 
 def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
           lr_mult: Optional[jax.Array] = None, loggers=(), timer=None,
-          ckpt_mgr=None, start_epoch: int = 0, writer=None, schedule=None):
+          ckpt_mgr=None, start_epoch: int = 0, writer=None, schedule=None,
+          telemetry=None):
     timer = timer or Timer()
+    # profiler window over --profile_rounds (telemetry/profiling.py);
+    # replaces the window previously hardcoded to rounds 2-4 of this
+    # driver only
+    prof = ProfilerWindow(cfg.profile_dir, cfg.profile_rounds)
     # device-resident data path: upload the dataset once, gather + augment
     # each round's batch on device, accumulate metrics on device, and fetch
     # once per epoch — a host<->device transfer costs ~170 ms latency on
@@ -290,6 +287,7 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
     spe = max(epoch_sampler(0).epoch_rounds(), 1)
     total_download_mb = total_upload_mb = 0.0
     global_round = start_epoch * spe
+    rounds_run = 0
     summary = None
 
     if cfg.eval_before_start:
@@ -297,110 +295,172 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                                              val_store=val_store)
         print(f"Test acc at epoch 0: {test_acc:0.4f}")
 
-    for epoch in range(start_epoch, math.ceil(cfg.num_epochs)):
-        epoch_fraction = (cfg.num_epochs - epoch
-                          if epoch == math.ceil(cfg.num_epochs) - 1 else 1.0)
-        ep_sums = None   # device accumulator: [loss*w, acc*w, w, down, up]
-        for i, rnd in enumerate(epoch_sampler(epoch)):
-            # fractional final epoch (reference cv_train.py:194-196)
-            if i >= spe * epoch_fraction:
-                break
-            global_round += 1
-            lr = schedule(global_round / spe)
-            lr_arr = (jnp.asarray(lr, jnp.float32) if lr_mult is None
-                      else lr * lr_mult)
-            if train_store is not None:
-                batch = train_store.round_batch(
-                    rnd.idx, jax.random.fold_in(data_key, global_round))
-            else:
-                batch = train_ds.gather(rnd.idx)
-                batch = {k: jnp.asarray(v) for k, v in batch.items()}
-            # profiler window: steady-state rounds 2-4 of the run
-            # (reference analogue: profile_helper, fed_aggregator.py:46-52)
-            if cfg.profile_dir and global_round == 2:
-                jax.profiler.start_trace(cfg.profile_dir)
-            state, metrics = runtime.round(
-                state, rnd.client_ids, batch, rnd.mask, lr_arr)
-            if cfg.profile_dir and global_round == 4:
-                jax.block_until_ready(state.ps_weights)
-                jax.profiler.stop_trace()
-                print(f"profiler trace written to {cfg.profile_dir}")
-            # accumulate on device: no host fetch inside the round loop
-            w = metrics["n_valid"]
-            contrib = jnp.stack([
-                (metrics["results"][0] * w).sum(),
-                (metrics["results"][1] * w).sum(),
-                w.sum(),
-                (metrics["download_bytes"].sum()
-                 if cfg.track_bytes else jnp.zeros(())),
-                (metrics["upload_bytes"].sum()
-                 if cfg.track_bytes else jnp.zeros(())),
-            ])
-            ep_sums = contrib if ep_sums is None else ep_sums + contrib
+    try:
+        for epoch in range(start_epoch, math.ceil(cfg.num_epochs)):
+            epoch_fraction = (cfg.num_epochs - epoch
+                              if epoch == math.ceil(cfg.num_epochs) - 1 else 1.0)
+            ep_sums = None   # device accumulator: [loss*w, acc*w, w, down, up]
+            for i, rnd in enumerate(epoch_sampler(epoch)):
+                # fractional final epoch (reference cv_train.py:194-196)
+                if i >= spe * epoch_fraction:
+                    break
+                global_round += 1
+                t_loop = time.perf_counter()
+                lr = schedule(global_round / spe)
+                lr_arr = (jnp.asarray(lr, jnp.float32) if lr_mult is None
+                          else lr * lr_mult)
+                if train_store is not None:
+                    batch = train_store.round_batch(
+                        rnd.idx, jax.random.fold_in(data_key, global_round))
+                else:
+                    batch = train_ds.gather(rnd.idx)
+                    batch = {k: jnp.asarray(v) for k, v in batch.items()}
+                t_host = time.perf_counter()
+                prof.maybe_start(global_round)
+                state, metrics = runtime.round(
+                    state, rnd.client_ids, batch, rnd.mask, lr_arr)
+                t_dispatch = time.perf_counter()
+                prof.maybe_stop(global_round,
+                                lambda: jax.block_until_ready(state.ps_weights))
+                every = cfg.telemetry_round_every
+                if (telemetry is not None and every
+                        and global_round % every == 0):
+                    # each round record costs ONE host sync of the round's
+                    # metrics — the price of round-granularity observability
+                    # (see config.telemetry_every); the device-side epoch
+                    # accumulation below is unchanged either way
+                    jax.block_until_ready(metrics)
+                    t_device = time.perf_counter()
+                    res = [np.asarray(r) for r in metrics["results"]]
+                    nv = np.asarray(metrics["n_valid"], np.float64)
+                    tot = max(float(nv.sum()), 1.0)
+                    acc_idx = 1 if len(res) > 1 else 0
+                    telemetry.round_event(
+                        rnd=global_round, epoch=epoch + 1, lr=float(lr),
+                        loss=float((res[0] * nv).sum() / tot),
+                        acc=float((res[acc_idx] * nv).sum() / tot),
+                        n_valid=float(nv.sum()),
+                        download_bytes=(
+                            float(np.asarray(
+                                metrics["download_bytes"]).sum())
+                            if cfg.track_bytes else None),
+                        upload_bytes=(
+                            float(np.asarray(metrics["upload_bytes"]).sum())
+                            if cfg.track_bytes else None),
+                        host_s=t_host - t_loop, dispatch_s=t_dispatch - t_host,
+                        device_s=t_device - t_dispatch)
+                rounds_run += 1
+                if telemetry is not None and rounds_run == 1:
+                    # device memory after the first round: weights + server
+                    # state + the round's working set are all live by now
+                    telemetry.memory_event("round_1")
+                # accumulate on device: no host fetch inside the round loop
+                w = metrics["n_valid"]
+                contrib = jnp.stack([
+                    (metrics["results"][0] * w).sum(),
+                    (metrics["results"][1] * w).sum(),
+                    w.sum(),
+                    (metrics["download_bytes"].sum()
+                     if cfg.track_bytes else jnp.zeros(())),
+                    (metrics["upload_bytes"].sum()
+                     if cfg.track_bytes else jnp.zeros(())),
+                ])
+                ep_sums = contrib if ep_sums is None else ep_sums + contrib
+                if cfg.do_test:
+                    break
+
+            sums = (np.asarray(ep_sums) if ep_sums is not None
+                    else np.zeros(5))
+            train_time = timer()
+            # NaN abort, checked at the epoch boundary (the reference checks per
+            # round, cv_train.py:222-224 — per-round host fetches are what this
+            # loop exists to avoid). The device-side flag reports the exact
+            # offending round and gates every checkpoint write below, so
+            # poisoned state is never persisted.
+            nan_round = int(state.nan_round)
+            if nan_round >= 0 or np.isnan(sums[0]):
+                which = (f"first non-finite update at round {nan_round}"
+                         if nan_round >= 0 else f"epoch loss {sums[0]} is NaN")
+                print(f"TRAINING DIVERGED ({which}), TERMINATING")
+                prof.finalize(lambda: jax.block_until_ready(state.ps_weights))
+                if telemetry is not None:
+                    # structured divergence diagnostic: which round went
+                    # non-finite, under what mode/clip/sketch config, and the
+                    # last records known finite — instead of only the bare
+                    # console line above
+                    telemetry.nan_abort(nan_round=nan_round, reason=which,
+                                        cfg=runtime.cfg)
+                    telemetry.write_summary(
+                        aborted=True, n_rounds=rounds_run,
+                        total_download_mib=total_download_mb,
+                        total_upload_mib=total_upload_mb,
+                        final=telemetry.last_epoch)
+                return state, None
+            total = max(float(sums[2]), 1.0)
+            train_loss = float(sums[0]) / total
+            train_acc = float(sums[1]) / total
+            download_mb = float(sums[3]) / (1024 * 1024)
+            upload_mb = float(sums[4]) / (1024 * 1024)
+            total_download_mb += download_mb
+            total_upload_mb += upload_mb
+
+            test_loss, test_acc = run_validation(runtime, state, val_ds, cfg,
+                                                 val_store=val_store)
+            test_time = timer()
+
+            summary = {
+                "epoch": epoch + 1,
+                "lr": schedule(global_round / spe),
+                "train_time": train_time,
+                "train_loss": train_loss,
+                "train_acc": train_acc,
+                "test_loss": test_loss,
+                "test_acc": test_acc,
+                "down (MiB)": round(download_mb),
+                "up (MiB)": round(upload_mb),
+                "total_time": timer.total_time,
+            }
+            for logger in loggers:
+                logger.append(summary)
+            if telemetry is not None:
+                telemetry.epoch_event(summary, test_time=test_time)
+                telemetry.memory_event(f"epoch_{epoch + 1}")
+            if writer is not None:
+                # reference scalar set (cv_train.py:150-158)
+                writer.add_scalar("Loss/train", train_loss, epoch)
+                writer.add_scalar("Loss/test", test_loss, epoch)
+                writer.add_scalar("Acc/train", train_acc, epoch)
+                writer.add_scalar("Acc/test", test_acc, epoch)
+                writer.add_scalar("Time/train", train_time, epoch)
+                writer.add_scalar("Time/test", test_time, epoch)
+                writer.add_scalar("Time/total", timer.total_time, epoch)
+                writer.add_scalar("Lr", summary["lr"], epoch)
+            if (ckpt_mgr is not None and cfg.checkpoint_every
+                    and (epoch + 1) % cfg.checkpoint_every == 0):
+                ckpt_mgr.save(state, epoch + 1, meta={"summary": summary})
             if cfg.do_test:
                 break
 
-        sums = (np.asarray(ep_sums) if ep_sums is not None
-                else np.zeros(5))
-        train_time = timer()
-        # NaN abort, checked at the epoch boundary (the reference checks per
-        # round, cv_train.py:222-224 — per-round host fetches are what this
-        # loop exists to avoid). The device-side flag reports the exact
-        # offending round and gates every checkpoint write below, so
-        # poisoned state is never persisted.
-        nan_round = int(state.nan_round)
-        if nan_round >= 0 or np.isnan(sums[0]):
-            which = (f"first non-finite update at round {nan_round}"
-                     if nan_round >= 0 else f"epoch loss {sums[0]} is NaN")
-            print(f"TRAINING DIVERGED ({which}), TERMINATING")
-            return state, None
-        total = max(float(sums[2]), 1.0)
-        train_loss = float(sums[0]) / total
-        train_acc = float(sums[1]) / total
-        download_mb = float(sums[3]) / (1024 * 1024)
-        upload_mb = float(sums[4]) / (1024 * 1024)
-        total_download_mb += download_mb
-        total_upload_mb += upload_mb
-
-        test_loss, test_acc = run_validation(runtime, state, val_ds, cfg,
-                                             val_store=val_store)
-        test_time = timer()
-
-        summary = {
-            "epoch": epoch + 1,
-            "lr": schedule(global_round / spe),
-            "train_time": train_time,
-            "train_loss": train_loss,
-            "train_acc": train_acc,
-            "test_loss": test_loss,
-            "test_acc": test_acc,
-            "down (MiB)": round(download_mb),
-            "up (MiB)": round(upload_mb),
-            "total_time": timer.total_time,
-        }
-        for logger in loggers:
-            logger.append(summary)
-        if writer is not None:
-            # reference scalar set (cv_train.py:150-158)
-            writer.add_scalar("Loss/train", train_loss, epoch)
-            writer.add_scalar("Loss/test", test_loss, epoch)
-            writer.add_scalar("Acc/train", train_acc, epoch)
-            writer.add_scalar("Acc/test", test_acc, epoch)
-            writer.add_scalar("Time/train", train_time, epoch)
-            writer.add_scalar("Time/test", test_time, epoch)
-            writer.add_scalar("Time/total", timer.total_time, epoch)
-            writer.add_scalar("Lr", summary["lr"], epoch)
-        if (ckpt_mgr is not None and cfg.checkpoint_every
-                and (epoch + 1) % cfg.checkpoint_every == 0):
-            ckpt_mgr.save(state, epoch + 1, meta={"summary": summary})
-        if cfg.do_test:
-            break
-
+    except BaseException:
+        # an unhandled crash (OOM, data error, Ctrl-C) inside the
+        # profiler window must still close the process-global trace
+        # (the rounds captured so far become a partial trace) —
+        # mirrors bench_common.timed_rounds' guard
+        prof.abort()
+        raise
+    # a window whose STOP lies beyond the last round (or that a --test /
+    # fractional-epoch break cut short) still yields its partial trace
+    prof.finalize(lambda: jax.block_until_ready(state.ps_weights))
     n_clients = train_ds.num_clients
     print(f"Total Download (MiB): {total_download_mb:0.2f}")
     print(f"Total Upload (MiB): {total_upload_mb:0.2f}")
     print(f"Avg Download Per Client: {total_download_mb / n_clients:0.2f}")
     print(f"Avg Upload Per Client: {total_upload_mb / n_clients:0.2f}")
+    if telemetry is not None:
+        telemetry.write_summary(aborted=False, n_rounds=rounds_run,
+                                total_download_mib=total_download_mb,
+                                total_upload_mib=total_upload_mb,
+                                final=telemetry.last_epoch)
     return state, summary
 
 
@@ -469,11 +529,29 @@ def main(argv=None):
         state = restored
 
     print(f"Finished initializing in {timer():.2f} seconds")
+    # ONE logdir for the whole run: telemetry and the tensorboard writer
+    # must share it (make_logdir timestamps at second resolution — two
+    # calls can split the artifacts across sibling directories)
+    logdir = (make_logdir(cfg)
+              if cfg.telemetry or cfg.use_tensorboard else None)
+    # telemetry opens against the runtime's RESOLVED config (grad_size
+    # filled in, num_cols auto-sized) so the manifest records the run
+    # that actually executes
+    telemetry = make_telemetry(runtime.cfg, "cv_train", logdir=logdir)
+    if telemetry is not None:
+        telemetry.instrument(runtime)
+        telemetry.memory_event("init")
     tsv = TSVLogger()
-    state, summary = train(cfg, runtime, state, train_ds, val_ds,
-                           lr_mult=lr_mult, loggers=(TableLogger(), tsv),
-                           timer=timer, ckpt_mgr=ckpt_mgr,
-                           start_epoch=start_epoch, writer=make_writer(cfg))
+    try:
+        state, summary = train(cfg, runtime, state, train_ds, val_ds,
+                               lr_mult=lr_mult, loggers=(TableLogger(), tsv),
+                               timer=timer, ckpt_mgr=ckpt_mgr,
+                               start_epoch=start_epoch,
+                               writer=make_writer(cfg, logdir=logdir),
+                               telemetry=telemetry)
+    finally:
+        if telemetry is not None:
+            telemetry.close()
     print(tsv)
 
     if cfg.do_checkpoint and summary is not None:

@@ -151,8 +151,21 @@ class FedDataset:
                 prefix = type(self).__name__
                 for fn in _glob.glob(
                         os.path.join(dataset_dir, f"{prefix}_*")) + [pref]:
-                    if not fn.endswith(".pre-marker.bak"):
-                        os.replace(fn, fn + ".pre-marker.bak")
+                    if ".pre-marker.bak" in fn:
+                        continue
+                    # never clobber an earlier run's preserved backup
+                    # (os.replace silently overwrites): suffix with a
+                    # counter so the FIRST backup — the one that may hold
+                    # a real-data prep — survives every re-preparation
+                    dst = fn + ".pre-marker.bak"
+                    n = 1
+                    while os.path.exists(dst):
+                        dst = fn + f".pre-marker.bak.{n}"
+                        n += 1
+                    if n > 1:
+                        print(f"WARNING: {fn + '.pre-marker.bak'} already "
+                              f"exists; keeping new backup as {dst}")
+                    os.replace(fn, dst)
             else:
                 print(f"WARNING: reusing prepared data under {dataset_dir} "
                       "that predates synthetic-prep markers; delete "

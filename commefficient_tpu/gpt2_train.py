@@ -226,13 +226,28 @@ def main(argv=None):
         state = restored
 
     from commefficient_tpu.cv_train import make_writer
+    from commefficient_tpu.telemetry import maybe_create as make_telemetry
+    from commefficient_tpu.utils import make_logdir
+    # one logdir shared by telemetry + tensorboard (see cv_train.main)
+    logdir = (make_logdir(cfg)
+              if cfg.telemetry or cfg.use_tensorboard else None)
+    # resolved config (grad_size, auto-sized num_cols) for the manifest
+    telemetry = make_telemetry(runtime.cfg, "gpt2_train", logdir=logdir)
+    if telemetry is not None:
+        telemetry.instrument(runtime)
+        telemetry.memory_event("init")
     tsv = TSVLogger()
-    state, summary = shared_train(cfg, runtime, state, train_ds, val_ds,
-                                  loggers=(TableLogger(), tsv), timer=timer,
-                                  ckpt_mgr=ckpt_mgr,
-                                  start_epoch=start_epoch,
-                                  schedule=make_gpt2_schedule(cfg),
-                                  writer=make_writer(cfg))
+    try:
+        state, summary = shared_train(cfg, runtime, state, train_ds, val_ds,
+                                      loggers=(TableLogger(), tsv),
+                                      timer=timer, ckpt_mgr=ckpt_mgr,
+                                      start_epoch=start_epoch,
+                                      schedule=make_gpt2_schedule(cfg),
+                                      writer=make_writer(cfg, logdir=logdir),
+                                      telemetry=telemetry)
+    finally:
+        if telemetry is not None:
+            telemetry.close()
     print(tsv)
 
     if summary is not None:

@@ -1,0 +1,28 @@
+"""Run-telemetry subsystem: structured per-round metrics, compile and
+memory observability, and profiler window management — shared by
+``cv_train.py``, ``gpt2_train.py``, ``bench.py`` and ``bench_gpt2.py``.
+See schema.py for the JSONL event schema and README.md ("Telemetry &
+profiling") for the consumer-facing contract."""
+
+from commefficient_tpu.telemetry.compilewatch import JitWatcher
+from commefficient_tpu.telemetry.profiling import (ProfilerWindow,
+                                                   parse_profile_rounds)
+from commefficient_tpu.telemetry.run import RunTelemetry, maybe_create
+from commefficient_tpu.telemetry.schema import (SCHEMA_VERSION,
+                                                TELEMETRY_BASENAME,
+                                                validate_event,
+                                                validate_file,
+                                                validate_lines)
+
+__all__ = [
+    "JitWatcher",
+    "ProfilerWindow",
+    "parse_profile_rounds",
+    "RunTelemetry",
+    "maybe_create",
+    "SCHEMA_VERSION",
+    "TELEMETRY_BASENAME",
+    "validate_event",
+    "validate_file",
+    "validate_lines",
+]

@@ -1,0 +1,113 @@
+"""Compile observability for the jitted round/val steps.
+
+``JitWatcher.wrap(name, fn)`` returns a callable that manages its own
+AOT cache keyed on the argument signature (treedef + leaf shape/dtype).
+The first call with a new signature runs ``fn.lower`` and ``.compile()``
+under split wall timers and logs a ``compile`` event carrying the XLA
+``cost_analysis()`` FLOPs / bytes-accessed — so a RECOMPILE (a shape
+change, a donation miss materializing a new layout) shows up as a
+second ``compile`` event for the same name instead of a silent
+multi-second (or, at GPT-2 scale, multi-minute) stall. Subsequent calls
+dispatch straight to the cached compiled executable, bypassing jit's
+own re-trace.
+
+Never trades correctness for observability: any failure in the AOT path
+(an input the signature key cannot describe, an executable rejecting an
+aval/sharding the plain jit path would accept) permanently drops the
+wrapper into pass-through mode for that function, logging one final
+``compile`` event with ``fallback: true``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict
+
+import jax
+
+
+def _signature(args) -> Any:
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (treedef, tuple(
+        (tuple(getattr(leaf, "shape", ())),
+         str(getattr(leaf, "dtype", type(leaf).__name__)))
+        for leaf in leaves))
+
+
+def _cost_analysis(compiled) -> Dict[str, Any]:
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return dict(cost) if cost else {}
+    except Exception:
+        return {}
+
+
+class JitWatcher:
+    """Wraps jitted callables; reports compiles to a RunTelemetry."""
+
+    def __init__(self, telemetry):
+        self._telemetry = telemetry
+        self.n_compiles = 0
+
+    def wrap(self, name: str, fn: Callable) -> Callable:
+        cache: Dict[Any, Any] = {}
+        state = {"fallback": False}
+
+        def emit(n, lower_s, compile_s, cost, fallback=False):
+            self.n_compiles += 1
+            self._telemetry.event(
+                "compile", name=name, n_compiles=n,
+                lower_s=round(lower_s, 6), compile_s=round(compile_s, 6),
+                flops=cost.get("flops"),
+                bytes_accessed=cost.get("bytes accessed"),
+                fallback=fallback)
+
+        def wrapped(*args):
+            if state["fallback"]:
+                return fn(*args)
+            try:
+                key = _signature(args)
+            except Exception:
+                state["fallback"] = True
+                emit(len(cache), 0.0, 0.0, {}, fallback=True)
+                return fn(*args)
+            compiled = cache.get(key)
+            if compiled is None:
+                try:
+                    t0 = time.perf_counter()
+                    lowered = fn.lower(*args)
+                    t1 = time.perf_counter()
+                    compiled = lowered.compile()
+                    t2 = time.perf_counter()
+                except Exception:
+                    # un-lowerable input (or an AOT-unsupported transform
+                    # nesting): give up on observation, keep the run alive
+                    state["fallback"] = True
+                    emit(len(cache), 0.0, 0.0, {}, fallback=True)
+                    return fn(*args)
+                cache[key] = compiled
+                emit(len(cache), t1 - t0, t2 - t1,
+                     _cost_analysis(compiled))
+            try:
+                return compiled(*args)
+            except Exception:
+                # AOT executables validate input avals/shardings more
+                # strictly than jit dispatch; if this signature's inputs
+                # slip past our key but not the executable, never risk the
+                # run — pass through to the plain jit path from here on.
+                state["fallback"] = True
+                emit(len(cache), 0.0, 0.0, {}, fallback=True)
+                # ONLY retry when the inputs are still alive: a failure
+                # DURING execution (OOM at scale) may already have
+                # consumed donated buffers, and retrying with deleted
+                # arrays would bury the real error under a confusing
+                # "Array has been deleted" — re-raise the original then.
+                if any(getattr(leaf, "is_deleted", lambda: False)()
+                       for leaf in jax.tree_util.tree_leaves(args)):
+                    raise
+                return fn(*args)
+
+        wrapped.__name__ = f"watched_{name}"
+        return wrapped

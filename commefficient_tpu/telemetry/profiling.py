@@ -1,0 +1,103 @@
+"""Profiler window management: ``--profile_rounds START:STOP``.
+
+Replaces the window hardcoded to rounds 2-4 of ``cv_train.py`` only:
+every driver (cv_train, gpt2_train) and both benchmarks now place the
+jax profiler trace over an arbitrary round range of the run. Rounds are
+1-based and the window is inclusive — the default "2:4" captures rounds
+2, 3 and 4, exactly the old behavior (skipping round 1 keeps the first
+compile out of the trace).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+
+def parse_profile_rounds(spec: str) -> Tuple[int, int]:
+    """Parse "START:STOP" (inclusive, 1-based). A bare "N" profiles the
+    single round N. Raises ValueError with an actionable message."""
+    s = spec.strip()
+    try:
+        if ":" in s:
+            a, b = s.split(":", 1)
+            start, stop = int(a), int(b)
+        else:
+            start = stop = int(s)
+    except ValueError:
+        raise ValueError(
+            f"--profile_rounds {spec!r} is not START:STOP (two integers, "
+            "e.g. '2:4') or a single round number") from None
+    if start < 1 or stop < start:
+        raise ValueError(
+            f"--profile_rounds {spec!r}: need 1 <= START <= STOP")
+    return start, stop
+
+
+class ProfilerWindow:
+    """Start/stop a jax profiler trace over a round window.
+
+    ``maybe_start(rnd)`` goes before the round's dispatch and
+    ``maybe_stop(rnd, sync)`` after it; ``sync`` is called before
+    stopping so the trace contains completed device work (a
+    ``block_until_ready`` on something the round produced). ``abort()``
+    closes a live trace on an error path — a retried benchmark attempt
+    must not leak an open trace into the profiler's global state.
+    """
+
+    def __init__(self, outdir: str, rounds: str = "2:4",
+                 log: Callable[[str], None] = print):
+        self.outdir = outdir
+        self.start, self.stop = (parse_profile_rounds(rounds) if outdir
+                                 else (0, 0))
+        self._log = log
+        self.active = False
+        self.done = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.outdir)
+
+    def maybe_start(self, rnd: int) -> None:
+        if (self.enabled and not self.done and not self.active
+                and self.start <= rnd <= self.stop):
+            import jax
+            jax.profiler.start_trace(self.outdir)
+            self.active = True
+
+    def maybe_stop(self, rnd: int,
+                   sync: Optional[Callable[[], None]] = None) -> None:
+        if self.active and rnd >= self.stop:
+            import jax
+            if sync is not None:
+                sync()
+            jax.profiler.stop_trace()
+            self.active = False
+            self.done = True
+            self._log(f"profiler trace written to {self.outdir}")
+
+    def finalize(self, sync: Optional[Callable[[], None]] = None) -> None:
+        """Close a window the run ended inside of (STOP beyond the last
+        round, a NaN abort, a fractional final epoch): the rounds captured
+        so far still become a trace — and the profiler's process-global
+        state is released — instead of silently losing both. No-op when
+        the window already closed (or never opened)."""
+        if self.active:
+            import jax
+            if sync is not None:
+                sync()
+            jax.profiler.stop_trace()
+            self.active = False
+            self.done = True
+            self._log(f"profiler trace written to {self.outdir} "
+                      "(window closed early: run ended before round "
+                      f"{self.stop})")
+
+    def abort(self) -> None:
+        if self.active:
+            self.active = False
+            self.done = True
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass

@@ -1,0 +1,280 @@
+"""RunTelemetry: the machine-readable event stream of one run.
+
+Writes ``telemetry.jsonl`` (schema.py) into the run's logdir next to
+whatever else the run records (tensorboard events, traces). The console
+TableLogger/TSVLogger output is deliberately untouched: telemetry is a
+parallel channel, not a replacement — the BENCH_r02 post-mortem (a
+dropped remote-compile body nearly losing a whole benchmark artifact)
+is why every event is flushed to disk the moment it happens, and why a
+telemetry failure only disables telemetry, never the run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+from commefficient_tpu.telemetry.compilewatch import JitWatcher
+from commefficient_tpu.telemetry.schema import (SCHEMA_VERSION,
+                                                TELEMETRY_BASENAME)
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, float):
+        # non-finite floats serialize as null: json.dumps would emit the
+        # literal NaN/Infinity tokens Python accepts but strict JSON
+        # parsers (jq, JSON.parse, serde) reject — and a diverging run is
+        # exactly when the stream must stay machine-readable. The schema
+        # treats the metric fields as nullable for this reason.
+        return v if math.isfinite(v) else None
+    if isinstance(v, (str, int, bool)) or v is None:
+        return v
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if hasattr(v, "item"):          # numpy / jax scalars
+        try:
+            return _jsonable(v.item())
+        except Exception:
+            pass
+    return str(v)
+
+
+def _sketch_geometry(cfg) -> Optional[Dict[str, Any]]:
+    if getattr(cfg, "mode", None) != "sketch":
+        return None
+    return {
+        "impl": cfg.sketch_impl,
+        "num_rows": cfg.num_rows,
+        "num_cols": cfg.num_cols,
+        "k": cfg.k,
+        "num_blocks": cfg.num_blocks,
+        "ef": cfg.sketch_ef,
+        "server_state": cfg.sketch_server_state,
+        "dtype": cfg.sketch_dtype,
+    }
+
+
+class RunTelemetry:
+    """Owns the JSONL stream; one instance per run (or per benchmark
+    artifact — bench.py threads its instance through bench_gpt2 so both
+    stages land in the same file)."""
+
+    def __init__(self, logdir: str, run_type: str, cfg=None,
+                 manifest_extra: Optional[Dict[str, Any]] = None):
+        self.logdir = logdir
+        self.run_type = run_type
+        self.path = os.path.join(logdir, TELEMETRY_BASENAME)
+        self._seq = 0
+        self._t0 = time.time()
+        self._file = None
+        self._counts: Dict[str, int] = {}
+        self._watcher: Optional[JitWatcher] = None
+        self.last_round: Optional[Dict[str, Any]] = None
+        self.last_epoch: Optional[Dict[str, Any]] = None
+        try:
+            os.makedirs(logdir, exist_ok=True)
+            self._file = open(self.path, "w")
+        except OSError as e:
+            print(f"WARNING: telemetry disabled ({e})", file=sys.stderr)
+            return
+        self.event("manifest", schema=SCHEMA_VERSION, run_type=run_type,
+                   **self._environment(), **self._config_fields(cfg),
+                   **(manifest_extra or {}))
+
+    # -------------------------------------------------------------- plumbing
+
+    @property
+    def active(self) -> bool:
+        """False once the stream failed to open or was closed/disabled."""
+        return self._file is not None
+
+    @staticmethod
+    def _environment() -> Dict[str, Any]:
+        import jax
+        devices = jax.devices()
+        return {
+            "jax_version": jax.__version__,
+            "backend": jax.default_backend(),
+            "device_kind": (getattr(devices[0], "device_kind", "unknown")
+                            if devices else "none"),
+            "device_count": len(devices),
+        }
+
+    @staticmethod
+    def _config_fields(cfg) -> Dict[str, Any]:
+        if cfg is None:
+            return {"mesh_shape": [], "mesh_axes": [], "grad_size": 0,
+                    "sketch": None, "config": {}}
+        return {
+            "mesh_shape": list(cfg.mesh_shape),
+            "mesh_axes": list(cfg.mesh_axes),
+            "grad_size": int(cfg.grad_size),
+            "sketch": _sketch_geometry(cfg),
+            "config": _jsonable(dataclasses.asdict(cfg)),
+        }
+
+    def event(self, kind: str, **fields) -> None:
+        """Append one event; never raises — a full disk or closed stream
+        prints one warning and disables further telemetry."""
+        if self._file is None:
+            return
+        record = {"event": kind, "t": time.time(), "seq": self._seq}
+        record.update({k: _jsonable(v) for k, v in fields.items()})
+        try:
+            # allow_nan=False backstops _jsonable's non-finite mapping:
+            # the stream must never contain tokens strict parsers reject
+            self._file.write(json.dumps(record, allow_nan=False) + "\n")
+            self._file.flush()
+        except (OSError, ValueError) as e:
+            print(f"WARNING: telemetry write failed, disabling ({e})",
+                  file=sys.stderr)
+            try:
+                self._file.close()
+            except Exception:
+                pass
+            self._file = None
+            return
+        self._seq += 1
+        self._counts[kind] = self._counts.get(kind, 0) + 1
+        if kind == "round":
+            # last_round feeds nan_abort as "last record known FINITE":
+            # a record whose loss/acc went non-finite (serialized null)
+            # must not overwrite the last healthy snapshot
+            if (record.get("loss") is not None
+                    and record.get("acc") is not None):
+                self.last_round = record
+        elif kind == "epoch":
+            self.last_epoch = record
+
+    def close(self) -> None:
+        if self._file is not None:
+            try:
+                self._file.close()
+            except Exception:
+                pass
+            self._file = None
+
+    def __enter__(self) -> "RunTelemetry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ compilation
+
+    def watcher(self) -> JitWatcher:
+        if self._watcher is None:
+            self._watcher = JitWatcher(self)
+        return self._watcher
+
+    def instrument(self, runtime) -> None:
+        """Attach compile observability to a FedRuntime's jitted steps."""
+        runtime.set_compile_watcher(self.watcher())
+
+    # --------------------------------------------------------------- records
+
+    def round_event(self, *, rnd: int, epoch: int, lr: float, loss: float,
+                    acc: float, n_valid: float,
+                    download_bytes: Optional[float],
+                    upload_bytes: Optional[float],
+                    host_s: float, dispatch_s: float,
+                    device_s: float) -> None:
+        self.event("round", round=rnd, epoch=epoch, lr=float(lr),
+                   loss=float(loss), acc=float(acc), n_valid=float(n_valid),
+                   download_bytes=download_bytes, upload_bytes=upload_bytes,
+                   host_s=round(host_s, 6), dispatch_s=round(dispatch_s, 6),
+                   device_s=round(device_s, 6))
+
+    def epoch_event(self, summary: Dict[str, Any], **extra) -> None:
+        """``summary`` is the exact dict the TableLogger receives; its
+        presentation keys ("down (MiB)") are normalized for the stream."""
+        s = dict(summary)
+        self.event("epoch", epoch=int(s.pop("epoch")),
+                   lr=float(s.pop("lr")),
+                   train_time=float(s.pop("train_time")),
+                   train_loss=float(s.pop("train_loss")),
+                   train_acc=float(s.pop("train_acc")),
+                   test_loss=float(s.pop("test_loss")),
+                   test_acc=float(s.pop("test_acc")),
+                   download_mib=float(s.pop("down (MiB)")),
+                   upload_mib=float(s.pop("up (MiB)")),
+                   total_time=float(s.pop("total_time")),
+                   **{**s, **extra})
+
+    def memory_event(self, phase: str) -> None:
+        """Per-device memory snapshot; best-effort everywhere (CPU
+        backends return no stats — the event still records the attempt,
+        plus the host RSS, so the stream shape is backend-independent)."""
+        if self._file is None:
+            return
+        import jax
+        devices = []
+        for d in jax.devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            devices.append({"id": int(d.id),
+                            "kind": getattr(d, "device_kind", "unknown"),
+                            "stats": _jsonable(stats) if stats else None})
+        rss = None
+        try:
+            import resource
+            rss = (resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                   * 1024)  # linux reports KiB
+        except Exception:
+            pass
+        self.event("memory", phase=phase, devices=devices,
+                   host_rss_bytes=rss)
+
+    def nan_abort(self, *, nan_round: int, reason: str, cfg) -> None:
+        """The structured replacement for the bare 'TRAINING DIVERGED'
+        exit: which round went non-finite, under which mode/clip/sketch
+        config, and the last records known finite."""
+        self.event("nan_abort", nan_round=int(nan_round), reason=reason,
+                   mode=cfg.mode,
+                   max_grad_norm=cfg.max_grad_norm,
+                   sketch=_sketch_geometry(cfg),
+                   last_round=self.last_round,
+                   last_epoch=self.last_epoch)
+
+    def bench_event(self, metric: str, result: Dict[str, Any]) -> None:
+        self.event("bench", metric=metric, result=result)
+
+    def write_summary(self, *, aborted: bool, n_rounds: int,
+                      total_download_mib: Optional[float] = None,
+                      total_upload_mib: Optional[float] = None,
+                      final: Optional[Dict[str, Any]] = None) -> None:
+        self.event("summary", run_type=self.run_type, aborted=aborted,
+                   n_rounds=int(n_rounds),
+                   total_download_mib=total_download_mib,
+                   total_upload_mib=total_upload_mib,
+                   wall_time_s=round(time.time() - self._t0, 3),
+                   event_counts=dict(self._counts),
+                   final=final)
+
+
+def maybe_create(cfg, run_type: str,
+                 logdir: Optional[str] = None) -> Optional[RunTelemetry]:
+    """Driver entry point: honor --no_telemetry, default the logdir to
+    the run's ``make_logdir`` location, announce the path on stderr
+    (stdout belongs to the byte-stable console loggers)."""
+    if not getattr(cfg, "telemetry", True):
+        return None
+    if logdir is None:
+        from commefficient_tpu.utils import make_logdir
+        logdir = make_logdir(cfg)
+    tel = RunTelemetry(logdir, run_type, cfg=cfg)
+    if not tel.active:
+        # the constructor already warned; do not announce (or hand the
+        # caller) a stream that was never created
+        return None
+    print(f"telemetry: {tel.path}", file=sys.stderr)
+    return tel

@@ -1,0 +1,227 @@
+"""The run-telemetry JSONL event schema, and its validator.
+
+One ``telemetry.jsonl`` line = one JSON object = one event. Every event
+carries the envelope fields ``event`` (type tag), ``t`` (unix seconds)
+and ``seq`` (0-based per-run counter, so a truncated stream is
+detectable). The first line of a well-formed stream is a ``manifest``
+and the last is a ``summary`` — the footer's absence marks a run that
+died rather than finished.
+
+The validator is dependency-free (no jsonschema package in the image):
+each event type maps its required fields to a type predicate; extra
+fields are always legal (forward compatibility), unknown event types
+are not. ``scripts/check_telemetry_schema.py`` and the tier-1 tests
+both run exactly this code, so the schema documented in README.md is
+the one actually enforced.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Tuple
+
+SCHEMA_VERSION = 1
+TELEMETRY_BASENAME = "telemetry.jsonl"
+
+
+def _num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _opt_num(v: Any) -> bool:
+    return v is None or _num(v)
+
+
+def _int(v: Any) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def _str(v: Any) -> bool:
+    return isinstance(v, str)
+
+
+def _bool(v: Any) -> bool:
+    return isinstance(v, bool)
+
+
+def _dict(v: Any) -> bool:
+    return isinstance(v, dict)
+
+
+def _opt_dict(v: Any) -> bool:
+    return v is None or isinstance(v, dict)
+
+
+def _list(v: Any) -> bool:
+    return isinstance(v, list)
+
+
+# event type -> {required field: predicate}. The envelope (event/t/seq)
+# is checked for every line before the per-type fields.
+EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
+    # run header: resolved config + environment, written once at open
+    "manifest": {
+        "schema": _int,
+        "run_type": _str,          # cv_train | gpt2_train | bench | ...
+        "jax_version": _str,
+        "backend": _str,
+        "device_kind": _str,
+        "device_count": _int,
+        "mesh_shape": _list,
+        "mesh_axes": _list,
+        "grad_size": _int,
+        "sketch": _opt_dict,       # geometry dict in sketch mode, else null
+        "config": _dict,           # full resolved FedConfig
+    },
+    # one federated round (emitted every cfg.telemetry_every rounds).
+    # loss/acc are null when the round's metrics went non-finite — the
+    # writer serializes NaN/inf as null so the stream stays strict JSON
+    "round": {
+        "round": _int,
+        "epoch": _int,
+        "lr": _num,
+        "loss": _opt_num,
+        "acc": _opt_num,
+        "n_valid": _num,
+        "download_bytes": _opt_num,   # null when --no_track_bytes
+        "upload_bytes": _opt_num,
+        "host_s": _num,               # host batch assembly
+        "dispatch_s": _num,           # jitted-call return (async dispatch)
+        "device_s": _num,             # block_until_ready remainder
+    },
+    # per-epoch validation record (mirrors the console table row);
+    # loss/acc metrics are null if non-finite (e.g. a NaN val sweep that
+    # does not trip the train-side divergence abort)
+    "epoch": {
+        "epoch": _int,
+        "lr": _num,
+        "train_time": _num,
+        "train_loss": _opt_num,
+        "train_acc": _opt_num,
+        "test_loss": _opt_num,
+        "test_acc": _opt_num,
+        "download_mib": _num,
+        "upload_mib": _num,
+        "total_time": _num,
+    },
+    # one XLA compile of a watched jitted function; n_compiles > 1 for a
+    # name means a RECOMPILE (shape change / donation miss) happened
+    "compile": {
+        "name": _str,
+        "n_compiles": _int,
+        "lower_s": _num,
+        "compile_s": _num,
+        "flops": _opt_num,            # XLA cost_analysis; null if opaque
+        "bytes_accessed": _opt_num,
+        "fallback": _bool,            # True: watcher gave up on AOT path
+    },
+    # per-device memory_stats() snapshot (+ host RSS)
+    "memory": {
+        "phase": _str,                # init | round_1 | epoch_<n> | ...
+        "devices": _list,             # [{id, kind, stats: dict|null}, ...]
+        "host_rss_bytes": _opt_num,
+    },
+    # structured divergence diagnostic, emitted instead of a bare exit
+    "nan_abort": {
+        "nan_round": _int,            # -1: host-side NaN (epoch loss)
+        "reason": _str,
+        "mode": _str,
+        "max_grad_norm": _opt_num,
+        "sketch": _opt_dict,
+        "last_round": _opt_dict,      # last finite round record, if any
+        "last_epoch": _opt_dict,      # last completed epoch record, if any
+    },
+    # benchmark stage result (bench.py / bench_gpt2.py share the stream)
+    "bench": {
+        "metric": _str,
+        "result": _dict,
+    },
+    # end-of-run footer
+    "summary": {
+        "run_type": _str,
+        "aborted": _bool,
+        "n_rounds": _int,
+        "total_download_mib": _opt_num,
+        "total_upload_mib": _opt_num,
+        "wall_time_s": _num,
+        "event_counts": _dict,
+        "final": _opt_dict,           # last epoch record / bench result
+    },
+}
+
+ENVELOPE = {"event": _str, "t": _num, "seq": _int}
+
+
+def validate_event(obj: Any) -> List[str]:
+    """Return a list of problems with one decoded event (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"event is not an object: {type(obj).__name__}"]
+    for field, pred in ENVELOPE.items():
+        if field not in obj:
+            problems.append(f"missing envelope field {field!r}")
+        elif not pred(obj[field]):
+            problems.append(f"envelope field {field!r} has wrong type")
+    kind = obj.get("event")
+    if not isinstance(kind, str):
+        return problems
+    spec = EVENT_FIELDS.get(kind)
+    if spec is None:
+        problems.append(f"unknown event type {kind!r}")
+        return problems
+    for field, pred in spec.items():
+        if field not in obj:
+            problems.append(f"{kind}: missing field {field!r}")
+        elif not pred(obj[field]):
+            problems.append(
+                f"{kind}: field {field!r} fails its type check "
+                f"(got {type(obj[field]).__name__})")
+    return problems
+
+
+def validate_lines(lines: Iterable[str]) -> List[Tuple[int, str]]:
+    """Validate an iterable of JSONL lines. Returns [(lineno, problem)];
+    also checks the stream shape: seq must be 0,1,2,..., the first event
+    must be a manifest with the current SCHEMA_VERSION."""
+    problems: List[Tuple[int, str]] = []
+    expected_seq = 0
+    for lineno, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError as e:
+            problems.append((lineno, f"not valid JSON: {e}"))
+            continue
+        for p in validate_event(obj):
+            problems.append((lineno, p))
+        if isinstance(obj, dict):
+            if expected_seq == 0 and obj.get("event") != "manifest":
+                problems.append((lineno, "first event must be a manifest"))
+            if (obj.get("event") == "manifest"
+                    and obj.get("schema") != SCHEMA_VERSION):
+                problems.append(
+                    (lineno, f"manifest schema {obj.get('schema')!r} != "
+                             f"supported {SCHEMA_VERSION}"))
+            if obj.get("seq") != expected_seq:
+                problems.append(
+                    (lineno, f"seq {obj.get('seq')!r} != expected "
+                             f"{expected_seq} (truncated/merged stream?)"))
+            if isinstance(obj.get("seq"), int):
+                # resynchronize to the observed counter: one gap is one
+                # problem, not a cascade of bogus mismatches on every
+                # following line
+                expected_seq = obj["seq"] + 1
+            else:
+                expected_seq += 1
+        # non-object lines (already flagged above) do not advance the
+        # counter: the writer's own seq continues around an insertion
+    if expected_seq == 0:
+        problems.append((0, "empty stream (no events)"))
+    return problems
+
+
+def validate_file(path: str) -> List[Tuple[int, str]]:
+    with open(path) as f:
+        return validate_lines(f)

@@ -1,0 +1,43 @@
+"""Version-portable jax surface.
+
+The runtime targets the newest jax (top-level ``jax.shard_map`` with the
+``check_vma`` kwarg) but must also run on the 0.4.x line, where the
+function lives in ``jax.experimental.shard_map`` and the same kwarg is
+named ``check_rep``. Resolve once at import time and translate the
+kwarg in whichever direction the installed jax needs, so every call
+site can use the modern spelling unconditionally.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.4.35 exports it at top level
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover - exercised on old jax only
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = set(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with ``check_vma``/``check_rep`` translated to
+    whatever the installed jax accepts (they are the same knob; it was
+    renamed when varying-manual-axes checking replaced rep checking)."""
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and "check_rep" not in _PARAMS:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(f, **kwargs)
+
+
+def pcast(x, axis_name, to="varying"):
+    """``lax.pcast`` where it exists; identity elsewhere. The call only
+    exists to mark replicated values as device-varying for the vma
+    checker — on jax lines without pcast there is no vma checker to
+    satisfy (rep checking is simply disabled via check_rep=False), so
+    the identity is the correct translation, not an approximation."""
+    from jax import lax
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to=to)
+    return x

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Lint committed telemetry streams against the schema.
+
+Validates every ``telemetry.jsonl`` under the given roots (default:
+``runs/``) with ``commefficient_tpu.telemetry.schema`` — the same code
+the writers and the tier-1 tests run, so a committed artifact that
+drifts from the documented schema fails CI instead of silently rotting.
+
+Usage:
+    python scripts/check_telemetry_schema.py [root ...]
+    python scripts/check_telemetry_schema.py path/to/telemetry.jsonl
+
+Exit status: 0 when every stream found is valid (or none exist),
+1 when any stream has problems, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from commefficient_tpu.telemetry.schema import (TELEMETRY_BASENAME,  # noqa: E402
+                                                validate_file)
+
+
+def find_streams(roots):
+    for root in roots:
+        if os.path.isfile(root):
+            yield root
+            continue
+        for dirpath, _, filenames in os.walk(root):
+            for fn in filenames:
+                if fn == TELEMETRY_BASENAME:
+                    yield os.path.join(dirpath, fn)
+
+
+def main(argv=None) -> int:
+    roots = (argv if argv is not None else sys.argv[1:]) or ["runs"]
+    for root in roots:
+        if not os.path.exists(root):
+            print(f"check_telemetry_schema: {root} does not exist",
+                  file=sys.stderr)
+            return 2
+    n_checked = n_bad = 0
+    for path in sorted(find_streams(roots)):
+        n_checked += 1
+        problems = validate_file(path)
+        if problems:
+            n_bad += 1
+            print(f"INVALID {path}:")
+            for lineno, problem in problems[:20]:
+                print(f"  line {lineno}: {problem}")
+            if len(problems) > 20:
+                print(f"  ... and {len(problems) - 20} more")
+        else:
+            print(f"ok      {path}")
+    print(f"{n_checked} stream(s) checked, {n_bad} invalid")
+    return 1 if n_bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

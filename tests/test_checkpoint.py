@@ -280,3 +280,58 @@ def test_scale_guard_and_sharded_save(tmp_path):
     # and it must still run a round
     s3, _ = rtm.round(c, cids, batch, mask, 0.05)
     assert np.isfinite(np.asarray(s3.ps_weights)).all()
+
+
+def test_sketch_gen_checked_before_materializing(tmp_path):
+    """A sketch-generation mismatch must be diagnosed from the META alone
+    — BEFORE load_state touches the (possibly shape-incompatible) arrays.
+    Pinned by corrupting the npz: if the check ran after materialization,
+    these restores would die on the corrupt file instead of raising the
+    explanatory ValueError."""
+    import pytest
+
+    rt = build_runtime()
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.default_meta = {"sketch_gen": "circ-v1-2x32-42"}
+    mgr.save(rt.init_state(), epoch=1)
+    npz = mgr._path(1) + ".npz"
+    with open(npz, "wb") as f:
+        f.write(b"not an npz at all")
+
+    # same-layout marker mismatch: explanatory refuse, no array touched
+    with pytest.raises(ValueError, match="sketch generation"):
+        mgr.restore_latest(expect_sketch_gen="circ-v1-2x64-42")
+    # cross-layout (table checkpoint under sketch_server_state=dense):
+    # the layout explanation, and --resume_unverified cannot override
+    for ok in (False, True):
+        with pytest.raises(ValueError, match="server state"):
+            mgr.restore_latest(
+                expect_sketch_gen="circ-v1-2x32-42-densestate",
+                sketch_mismatch_ok=ok)
+    # pre-marker checkpoint (no sketch_gen in meta): unverifiable wording
+    mgr2 = CheckpointManager(str(tmp_path / "ck2"))
+    mgr2.save(rt.init_state(), epoch=1)
+    with open(mgr2._path(1) + ".npz", "wb") as f:
+        f.write(b"junk")
+    with pytest.raises(ValueError, match="predates sketch-generation"):
+        mgr2.restore_latest(expect_sketch_gen="circ-v1-2x32-42")
+    # non-sketch restoring runs (expect None) skip the check entirely and
+    # only then hit the corrupt file
+    with pytest.raises(Exception, match="(?i)(zip|pickle|magic|file)"):
+        mgr2.restore_latest(expect_sketch_gen=None)
+
+
+def test_sketch_gen_mismatch_ok_loads_state(tmp_path):
+    """--resume_unverified (sketch_mismatch_ok) still LOADS a same-layout
+    mismatched checkpoint; the driver then discards the tables."""
+    rt = build_runtime()
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.default_meta = {"sketch_gen": "circ-v1-2x32-42"}
+    s = rt.init_state()
+    mgr.save(s, epoch=2)
+    restored, meta = mgr.restore_latest(
+        expect_sketch_gen="circ-aligned1024-2x32-43",
+        sketch_mismatch_ok=True)
+    assert restored is not None and meta["sketch_gen"] == "circ-v1-2x32-42"
+    np.testing.assert_array_equal(np.asarray(restored.ps_weights),
+                                  np.asarray(s.ps_weights))

@@ -449,3 +449,30 @@ class TestNanFlag:
         assert summary is None            # aborted
         assert int(state.nan_round) == 0  # flagged on the very first round
         assert mgr.epochs() == []         # nothing persisted
+
+
+def test_subtract_ef_rejected_on_dense_preimage_paths():
+    """--sketch_ef subtract is a TABLE-space rule; the dense-preimage
+    server paths (sketch_server_state=dense, and rht's dense transform)
+    would silently ignore it — they must refuse instead (ADVICE.md)."""
+    from commefficient_tpu.core.server import validate_mode_combo
+    common = dict(mode="sketch", error_type="virtual", local_momentum=0.0,
+                  k=5, num_rows=2, num_cols=32)
+    # the legal study configurations still validate
+    validate_mode_combo(FedConfig(**common, sketch_ef="subtract"))
+    validate_mode_combo(FedConfig(**common, sketch_ef="subtract",
+                                  sketch_impl="hash"))
+    validate_mode_combo(FedConfig(**common, sketch_server_state="dense"))
+    with pytest.raises(ValueError, match="sketch_ef subtract"):
+        validate_mode_combo(FedConfig(**common, sketch_ef="subtract",
+                                      sketch_server_state="dense"))
+    with pytest.raises(ValueError, match="sketch_ef subtract"):
+        validate_mode_combo(FedConfig(**common, sketch_ef="subtract",
+                                      sketch_impl="rht"))
+    # and the runtime constructor (both drivers' entry point) enforces it
+    params = {"w": jnp.zeros((4, 2), jnp.float32)}
+    with pytest.raises(ValueError, match="sketch_ef subtract"):
+        FedRuntime(FedConfig(**common, num_workers=2, local_batch_size=2,
+                             sketch_ef="subtract",
+                             sketch_server_state="dense"),
+                   params, loss_fn, num_clients=4)

@@ -197,3 +197,42 @@ def test_emnist_synthetic_splits_share_prototypes(tmp_path):
     d = ((tm[ti][:, None] - vm[vi][None]) ** 2).sum(axis=(-1, -2))
     # own-class distance must be the row minimum for every common class
     assert (d.argmin(axis=1) == np.arange(len(common))).all()
+
+
+def test_premarker_backup_not_clobbered(tmp_path):
+    """Re-preparing a marker-less synthetic dir twice must keep the FIRST
+    .pre-marker.bak (the one that could hold a real-data prep) instead of
+    os.replace-ing over it; later backups get a counter suffix."""
+    import glob
+    import json
+    import os
+
+    d = str(tmp_path)
+    FedCIFAR10(d, synthetic=True, synthetic_per_class=8)
+    pref = os.path.join(d, "stats_FedCIFAR10.json")
+
+    def strip_marker():
+        with open(pref) as f:
+            meta = json.load(f)
+        meta.pop("synthetic", None)
+        with open(pref, "w") as f:
+            json.dump(meta, f)
+
+    strip_marker()
+    FedCIFAR10(d, synthetic=True, synthetic_per_class=8)
+    first = sorted(glob.glob(os.path.join(d, "*.pre-marker.bak")))
+    assert first, "expected pre-marker backups after re-preparation"
+    sentinel = first[0]
+    with open(sentinel, "w") as f:
+        f.write("FIRST-GENERATION-BACKUP")
+
+    strip_marker()
+    FedCIFAR10(d, synthetic=True, synthetic_per_class=8)
+    # the first-generation backup survived byte-for-byte...
+    with open(sentinel) as f:
+        assert f.read() == "FIRST-GENERATION-BACKUP"
+    # ...and the second generation landed under a counter suffix
+    assert glob.glob(os.path.join(d, "*.pre-marker.bak.1"))
+    # backup files themselves are never re-backed-up
+    assert not glob.glob(os.path.join(d, "*.pre-marker.bak.bak*"))
+    assert not glob.glob(os.path.join(d, "*.pre-marker.bak.pre-marker*"))

@@ -1,0 +1,564 @@
+"""Run-telemetry subsystem (telemetry/): schema round-trip, per-round
+records through a real federated round, compile observability, NaN-abort
+diagnostics, profiler-window parsing, and the console-output golden
+check (telemetry must never change what the TableLogger/TSVLogger
+print)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.core import FedRuntime
+from commefficient_tpu.telemetry import (ProfilerWindow, RunTelemetry,
+                                         parse_profile_rounds,
+                                         validate_event, validate_file,
+                                         validate_lines)
+from commefficient_tpu.telemetry.schema import TELEMETRY_BASENAME
+from commefficient_tpu.utils import TableLogger, TSVLogger
+
+W, B, D_IN, D_OUT = 4, 4, 6, 3
+
+
+def loss_fn(params, batch, mask):
+    pred = batch["x"] @ params["w"]
+    m = mask.astype(jnp.float32)
+    denom = jnp.maximum(m.sum(), 1.0)
+    err = ((pred - batch["y"]) ** 2).sum(axis=1)
+    loss = (err * m).sum() / denom
+    return loss, (loss,)
+
+
+def make_runtime(**kw):
+    cfg_kw = dict(mode="sketch", error_type="virtual", local_momentum=0.0,
+                  virtual_momentum=0.9, weight_decay=0.0, num_workers=W,
+                  local_batch_size=B, track_bytes=True, num_clients=8,
+                  num_results_train=2, num_results_val=2,
+                  k=5, num_rows=2, num_cols=32, exact_num_cols=True)
+    cfg_kw.update(kw)
+    params = {"w": jnp.asarray(
+        np.random.RandomState(0).randn(D_IN, D_OUT), jnp.float32)}
+    return FedRuntime(FedConfig(**cfg_kw), params, loss_fn, num_clients=8)
+
+
+def make_batch(seed=1):
+    rng = np.random.RandomState(seed)
+    batch = {"x": jnp.asarray(rng.randn(W, B, D_IN), jnp.float32),
+             "y": jnp.asarray(rng.randn(W, B, D_OUT), jnp.float32)}
+    return batch, jnp.ones((W, B), bool), jnp.arange(W, dtype=jnp.int32)
+
+
+def run_instrumented(tmp_path, n_rounds=3, **cfg_kw):
+    rt = make_runtime(**cfg_kw)
+    tel = RunTelemetry(str(tmp_path), "test", cfg=rt.cfg)
+    tel.instrument(rt)
+    state = rt.init_state()
+    batch, mask, ids = make_batch()
+    for _ in range(n_rounds):
+        state, metrics = rt.round(state, ids, batch, mask, 0.05)
+    return rt, tel, state, metrics
+
+
+def read_events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+# --------------------------------------------------------------- schema
+
+
+def test_schema_roundtrip_full_stream(tmp_path):
+    """Every event helper produces lines the validator accepts, and the
+    stream as a whole (manifest first, contiguous seq) is valid."""
+    rt, tel, state, metrics = run_instrumented(tmp_path)
+    tel.memory_event("init")
+    res = [np.asarray(r) for r in metrics["results"]]
+    nv = np.asarray(metrics["n_valid"])
+    tel.round_event(rnd=1, epoch=1, lr=0.05,
+                    loss=float(res[0].mean()), acc=float(res[1].mean()),
+                    n_valid=float(nv.sum()),
+                    download_bytes=1.0, upload_bytes=2.0,
+                    host_s=0.1, dispatch_s=0.2, device_s=0.3)
+    tel.epoch_event({"epoch": 1, "lr": 0.05, "train_time": 1.0,
+                     "train_loss": 2.0, "train_acc": 0.1,
+                     "test_loss": 2.1, "test_acc": 0.1,
+                     "down (MiB)": 3, "up (MiB)": 4, "total_time": 5.0})
+    tel.nan_abort(nan_round=7, reason="test", cfg=rt.cfg)
+    tel.write_summary(aborted=False, n_rounds=3,
+                      total_download_mib=1.0, total_upload_mib=2.0,
+                      final=tel.last_epoch)
+    tel.close()
+    path = os.path.join(str(tmp_path), TELEMETRY_BASENAME)
+    assert validate_file(path) == []
+    events = read_events(path)
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "manifest"
+    assert kinds[-1] == "summary"
+    for needed in ("compile", "memory", "round", "epoch", "nan_abort"):
+        assert needed in kinds, kinds
+    assert [e["seq"] for e in events] == list(range(len(events)))
+    # the manifest records the resolved run
+    man = events[0]
+    assert man["grad_size"] == rt.cfg.grad_size
+    assert man["sketch"]["num_cols"] == rt.cfg.num_cols
+    assert man["config"]["mode"] == "sketch"
+    assert man["jax_version"] == jax.__version__
+
+
+def test_validator_rejects_bad_streams():
+    ok = json.dumps({"event": "manifest", "t": 0.0, "seq": 0, "schema": 1,
+                     "run_type": "t", "jax_version": "x", "backend": "cpu",
+                     "device_kind": "cpu", "device_count": 1,
+                     "mesh_shape": [], "mesh_axes": [], "grad_size": 1,
+                     "sketch": None, "config": {}})
+    assert validate_lines([ok]) == []
+    # unknown event type
+    assert validate_event({"event": "nope", "t": 0.0, "seq": 0})
+    # missing required field
+    assert validate_event({"event": "round", "t": 0.0, "seq": 0})
+    # wrong type
+    bad = json.loads(ok)
+    bad["grad_size"] = "one"
+    assert validate_event(bad)
+    # stream-shape checks: first line must be a manifest, seq contiguous
+    rnd = json.dumps({"event": "memory", "t": 0.0, "seq": 0, "phase": "p",
+                      "devices": [], "host_rss_bytes": None})
+    assert any("manifest" in p for _, p in validate_lines([rnd]))
+    gap = json.loads(ok)
+    gap2 = {"event": "memory", "t": 0.0, "seq": 5, "phase": "p",
+            "devices": [], "host_rss_bytes": None}
+    probs = validate_lines([json.dumps(gap), json.dumps(gap2)])
+    assert any("seq" in p for _, p in probs)
+    # not JSON
+    assert validate_lines(["{nope"])
+
+
+def test_check_script_on_runs_tree(tmp_path):
+    """The CI lint (scripts/check_telemetry_schema.py) accepts a valid
+    stream and fails on a corrupted one."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                     "check_telemetry_schema.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    tel = RunTelemetry(str(tmp_path / "runA"), "test", cfg=None)
+    tel.write_summary(aborted=False, n_rounds=0)
+    tel.close()
+    assert mod.main([str(tmp_path)]) == 0
+    with open(tmp_path / "runA" / TELEMETRY_BASENAME, "a") as f:
+        f.write('{"event": "bogus"}\n')
+    assert mod.main([str(tmp_path)]) == 1
+    assert mod.main([str(tmp_path / "missing")]) == 2
+
+
+# ------------------------------------------------------------ round records
+
+
+def test_round_record_contents_under_track_bytes(tmp_path):
+    """The driver-side round record must carry the simulated byte
+    accounting: upload = 4 bytes x upload_floats x participating clients,
+    download per the changed-coordinate rule."""
+    from commefficient_tpu.cv_train import train  # noqa: F401 (import check)
+    rt, tel, state, metrics = run_instrumented(tmp_path, n_rounds=2)
+    up = float(np.asarray(metrics["upload_bytes"]).sum())
+    assert up == 4.0 * rt.cfg.upload_floats * W
+    res = [np.asarray(r) for r in metrics["results"]]
+    nv = np.asarray(metrics["n_valid"])
+    tel.round_event(rnd=2, epoch=1, lr=0.05,
+                    loss=float((res[0] * nv).sum() / nv.sum()),
+                    acc=float((res[1] * nv).sum() / nv.sum()),
+                    n_valid=float(nv.sum()),
+                    download_bytes=float(
+                        np.asarray(metrics["download_bytes"]).sum()),
+                    upload_bytes=up,
+                    host_s=0.0, dispatch_s=0.0, device_s=0.0)
+    tel.close()
+    events = read_events(tel.path)
+    rec = [e for e in events if e["event"] == "round"][-1]
+    assert rec["upload_bytes"] == up
+    assert rec["n_valid"] == W * B
+    assert np.isfinite(rec["loss"])
+    # round 2: every client re-downloads the coordinates round 1 changed
+    assert rec["download_bytes"] > 0
+
+
+class StubDS:
+    """Minimal FedDataset stand-in for driving cv_train.train directly:
+    train gathers see (W, B) index arrays, val gathers see (B,) — the
+    returned leaf shapes mirror the index shape, exactly like a real
+    dataset's per-item rows."""
+
+    data_per_client = np.full(8, B)
+    num_clients = 8
+
+    def __init__(self, scale: float = 1.0):
+        self.scale = scale
+
+    def __len__(self):
+        return 8 * B
+
+    def gather(self, idx):
+        idx = np.asarray(idx)
+        rng = np.random.RandomState(0)
+        return {"x": (self.scale
+                      * rng.randn(*idx.shape, D_IN).astype(np.float32)),
+                "y": rng.randn(*idx.shape, D_OUT).astype(np.float32)}
+
+
+def test_driver_loop_emits_round_events(tmp_path, capsys):
+    """End-to-end through cv_train.train's telemetry wiring: run the real
+    train() loop on the quad runtime with a stub dataset."""
+    from commefficient_tpu import cv_train
+
+    # dataset_name outside the DeviceStore table => host gather path;
+    # telemetry_every=1 pins per-round records (the non-test auto
+    # cadence is 64 and this run is 2 rounds long)
+    rt = make_runtime(dataset_name="SYNTH", telemetry_every=1)
+    tel = RunTelemetry(str(tmp_path), "cv_train", cfg=rt.cfg)
+    tel.instrument(rt)
+    cfg = rt.cfg.replace(num_epochs=1.0, pivot_epoch=0.5)
+    state = rt.init_state()
+    ds = StubDS()
+
+    state, summary = cv_train.train(
+        cfg, rt, state, ds, ds, loggers=(TableLogger(),), telemetry=tel)
+    tel.close()
+    assert summary is not None
+    assert validate_file(tel.path) == []
+    events = read_events(tel.path)
+    kinds = [e["event"] for e in events]
+    assert kinds.count("round") >= 1
+    assert "summary" in kinds and "epoch" in kinds
+    rec = [e for e in events if e["event"] == "round"][0]
+    for key in ("host_s", "dispatch_s", "device_s",
+                "download_bytes", "upload_bytes"):
+        assert key in rec
+    mem_phases = [e["phase"] for e in events if e["event"] == "memory"]
+    assert "round_1" in mem_phases and "epoch_1" in mem_phases
+
+
+# ------------------------------------------------------------ compile events
+
+
+def test_compile_events_and_recompile_visibility(tmp_path):
+    rt, tel, state, _ = run_instrumented(tmp_path, n_rounds=2)
+    events = [e for e in read_events(tel.path) if e["event"] == "compile"]
+    assert len(events) == 1, events  # one signature => ONE compile event
+    ev = events[0]
+    assert ev["name"] == "round_step" and ev["n_compiles"] == 1
+    assert ev["flops"] and ev["flops"] > 0
+    assert ev["compile_s"] >= 0 and ev["lower_s"] >= 0
+    assert ev["fallback"] is False
+    # a changed round shape (fewer workers) must surface as a SECOND
+    # compile event for the same function, n_compiles == 2
+    batch, mask, ids = make_batch()
+    half = {k: v[:2] for k, v in batch.items()}
+    state, _ = rt.round(state, ids[:2], half, mask[:2], 0.05)
+    events = [e for e in read_events(tel.path) if e["event"] == "compile"]
+    assert len(events) == 2
+    assert events[1]["n_compiles"] == 2
+    tel.close()
+
+
+def test_watched_round_matches_unwatched(tmp_path):
+    """Instrumentation must not change numerics: same rounds, same
+    weights, watched vs not."""
+    rt1 = make_runtime()
+    rt2 = make_runtime()
+    tel = RunTelemetry(str(tmp_path), "test", cfg=rt2.cfg)
+    tel.instrument(rt2)
+    batch, mask, ids = make_batch()
+    s1, s2 = rt1.init_state(), rt2.init_state()
+    for _ in range(3):
+        s1, m1 = rt1.round(s1, ids, batch, mask, 0.05)
+        s2, m2 = rt2.round(s2, ids, batch, mask, 0.05)
+    np.testing.assert_array_equal(np.asarray(s1.ps_weights),
+                                  np.asarray(s2.ps_weights))
+    tel.close()
+
+
+# --------------------------------------------------------------- NaN abort
+
+
+def test_nan_abort_event(tmp_path):
+    rt, tel, state, _ = run_instrumented(tmp_path, n_rounds=1)
+    tel.nan_abort(nan_round=3,
+                  reason="first non-finite update at round 3", cfg=rt.cfg)
+    tel.close()
+    events = read_events(tel.path)
+    ev = [e for e in events if e["event"] == "nan_abort"]
+    assert len(ev) == 1
+    ev = ev[0]
+    assert ev["nan_round"] == 3
+    assert ev["mode"] == "sketch"
+    assert ev["sketch"]["impl"] == rt.cfg.sketch_impl
+    assert ev["max_grad_norm"] is None
+    assert validate_file(tel.path) == []
+
+
+def test_train_loop_nan_abort_emits_event(tmp_path, capsys):
+    """Drive the real train() loop into divergence (overflowing inputs)
+    and check the structured diagnostic is emitted with the abort
+    summary."""
+    from commefficient_tpu import cv_train
+
+    rt = make_runtime(dataset_name="SYNTH")
+    tel = RunTelemetry(str(tmp_path), "cv_train", cfg=rt.cfg)
+    cfg = rt.cfg.replace(num_epochs=1.0, pivot_epoch=0.5, lr_scale=1e30)
+    state, summary = cv_train.train(cfg, rt, state=rt.init_state(),
+                                    train_ds=StubDS(scale=1e25),
+                                    val_ds=StubDS(scale=1e25),
+                                    telemetry=tel)
+    tel.close()
+    assert summary is None  # diverged
+    out = capsys.readouterr().out
+    assert "TRAINING DIVERGED" in out
+    events = read_events(tel.path)
+    kinds = [e["event"] for e in events]
+    assert "nan_abort" in kinds
+    assert events[-1]["event"] == "summary" and events[-1]["aborted"]
+    assert validate_file(tel.path) == []
+
+
+# ---------------------------------------------------------- profiler window
+
+
+def test_parse_profile_rounds():
+    assert parse_profile_rounds("2:4") == (2, 4)
+    assert parse_profile_rounds("7") == (7, 7)
+    assert parse_profile_rounds(" 1:1 ") == (1, 1)
+    for bad in ("", "4:2", "0:3", "a:b", "1:2:3", "-1:4"):
+        with pytest.raises(ValueError):
+            parse_profile_rounds(bad)
+    # config fails fast on a bad window only when profiling is requested
+    FedConfig(profile_rounds="nope")
+    with pytest.raises(ValueError):
+        FedConfig(profile_dir="/tmp/x", profile_rounds="nope")
+
+
+def test_profiler_window_lifecycle(monkeypatch, tmp_path):
+    calls = []
+    import jax.profiler as prof_mod
+    monkeypatch.setattr(prof_mod, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(prof_mod, "stop_trace",
+                        lambda: calls.append(("stop",)))
+    logged = []
+    win = ProfilerWindow(str(tmp_path), "2:3", log=logged.append)
+    synced = []
+    for rnd in range(1, 6):
+        win.maybe_start(rnd)
+        win.maybe_stop(rnd, lambda: synced.append(rnd))
+    assert calls == [("start", str(tmp_path)), ("stop",)]
+    assert synced == [3]        # synced exactly once, at the stop round
+    assert win.done and not win.active
+    assert logged and "profiler trace written" in logged[0]
+    # disabled window does nothing
+    calls.clear()
+    win2 = ProfilerWindow("", "2:3")
+    win2.maybe_start(2), win2.maybe_stop(3)
+    assert calls == []
+
+
+def test_telemetry_every_auto_resolution():
+    """-1 = auto: per-round under --test, every 64 rounds otherwise;
+    explicit values pass through."""
+    assert FedConfig().telemetry_round_every == 64
+    assert FedConfig(do_test=True).telemetry_round_every == 1
+    assert FedConfig(telemetry_every=7).telemetry_round_every == 7
+    assert FedConfig(telemetry_every=0, do_test=True).telemetry_round_every \
+        == 0
+
+
+def test_profiler_window_finalize(monkeypatch, tmp_path):
+    """A window the run ends inside of (STOP beyond the last round) still
+    writes its partial trace and releases the profiler."""
+    calls = []
+    import jax.profiler as prof_mod
+    monkeypatch.setattr(prof_mod, "start_trace",
+                        lambda d: calls.append("start"))
+    monkeypatch.setattr(prof_mod, "stop_trace",
+                        lambda: calls.append("stop"))
+    logged = []
+    win = ProfilerWindow(str(tmp_path), "2:1000", log=logged.append)
+    win.maybe_start(2)
+    win.maybe_stop(2)          # stop round never reached
+    assert win.active
+    synced = []
+    win.finalize(lambda: synced.append(True))
+    assert calls == ["start", "stop"] and synced == [True]
+    assert win.done and not win.active
+    assert logged and "closed early" in logged[0]
+    win.finalize()             # idempotent
+    assert calls == ["start", "stop"]
+
+
+def test_profiler_window_abort(monkeypatch, tmp_path):
+    calls = []
+    import jax.profiler as prof_mod
+    monkeypatch.setattr(prof_mod, "start_trace",
+                        lambda d: calls.append("start"))
+    monkeypatch.setattr(prof_mod, "stop_trace",
+                        lambda: calls.append("stop"))
+    win = ProfilerWindow(str(tmp_path), "1:5", log=lambda *_: None)
+    win.maybe_start(1)
+    win.abort()
+    assert calls == ["start", "stop"]
+    # a retried attempt must not re-open the trace
+    win.maybe_start(2)
+    assert calls == ["start", "stop"]
+
+
+def test_bench_timed_rounds_with_profiler(monkeypatch, tmp_path):
+    """bench_common.timed_rounds drives the profiler over the timed
+    rounds and still returns a sane timing."""
+    calls = []
+    import jax.profiler as prof_mod
+    monkeypatch.setattr(prof_mod, "start_trace",
+                        lambda d: calls.append("start"))
+    monkeypatch.setattr(prof_mod, "stop_trace",
+                        lambda: calls.append("stop"))
+    import bench_common
+    rt = make_runtime()
+    batch, mask, ids = make_batch()
+    win = ProfilerWindow(str(tmp_path), "1:2", log=lambda *_: None)
+    dt, metrics = bench_common.timed_rounds(
+        rt, (ids, batch, mask, 0.05), warmup=1, rounds=3, desc="t",
+        profiler=win)
+    assert dt > 0 and calls == ["start", "stop"]
+
+
+# ------------------------------------------------------------ console golden
+
+
+def test_console_output_unchanged_golden(capsys):
+    """The TableLogger/TSVLogger console contract is byte-stable, with
+    telemetry attached or not: telemetry writes ONLY to its jsonl (and
+    stderr), never stdout."""
+    summary = {"epoch": 1, "lr": 0.2, "train_time": 3.5, "train_loss": 2.0,
+               "train_acc": 0.5, "test_loss": 1.9, "test_acc": 0.55,
+               "down (MiB)": 12, "up (MiB)": 3, "total_time": 7.25}
+    golden = (
+        "       epoch           lr   train_time   train_loss    train_acc"
+        "    test_loss     test_acc   down (MiB)     up (MiB)   total_time\n"
+        "           1       0.2000       3.5000       2.0000       0.5000"
+        "       1.9000       0.5500           12            3       7.2500\n"
+    )
+    tl = TableLogger()
+    tl.append(summary)
+    assert capsys.readouterr().out == golden
+
+    tsv = TSVLogger()
+    tsv.append(summary)
+    assert str(tsv) == "epoch,hours,top1Accuracy\n1,0.00201389,55.00"
+
+    # identical rows with a telemetry stream attached to the same summary
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        tel = RunTelemetry(d, "test", cfg=None)
+        capsys.readouterr()
+        tl2 = TableLogger()
+        tl2.append(summary)
+        tel.epoch_event(summary)
+        tel.close()
+        assert capsys.readouterr().out == golden
+
+
+def test_committed_runs_streams_are_valid():
+    """CI guard: every telemetry.jsonl committed under runs/ must parse
+    against the current schema (none committed yet => trivially green)."""
+    repo = os.path.join(os.path.dirname(__file__), os.pardir)
+    runs = os.path.join(repo, "runs")
+    if not os.path.isdir(runs):
+        pytest.skip("no runs/ tree")
+    bad = {}
+    for dirpath, _, filenames in os.walk(runs):
+        for fn in filenames:
+            if fn == TELEMETRY_BASENAME:
+                path = os.path.join(dirpath, fn)
+                problems = validate_file(path)
+                if problems:
+                    bad[path] = problems[:5]
+    assert not bad, bad
+
+
+def test_non_finite_metrics_serialize_as_null(tmp_path):
+    """NaN/inf metric values must land as JSON null (strict parsers
+    reject Python's NaN/Infinity tokens), and a non-finite round record
+    must not overwrite last_round (nan_abort's last-known-FINITE
+    context)."""
+    tel = RunTelemetry(str(tmp_path), "test", cfg=None)
+    tel.round_event(rnd=1, epoch=1, lr=0.1, loss=1.5, acc=0.5, n_valid=4,
+                    download_bytes=None, upload_bytes=1.0,
+                    host_s=0, dispatch_s=0, device_s=0)
+    tel.round_event(rnd=2, epoch=1, lr=0.1, loss=float("nan"),
+                    acc=float("inf"), n_valid=4, download_bytes=None,
+                    upload_bytes=1.0, host_s=0, dispatch_s=0, device_s=0)
+    assert tel.last_round["round"] == 1  # the finite one
+    tel.write_summary(aborted=True, n_rounds=2, final=tel.last_round)
+    tel.close()
+    raw = open(tel.path).read()
+    assert "NaN" not in raw and "Infinity" not in raw
+    events = [json.loads(l, parse_constant=lambda c: pytest.fail(
+        f"non-strict token {c}")) for l in raw.splitlines()]
+    rec2 = [e for e in events if e["event"] == "round"][1]
+    assert rec2["loss"] is None and rec2["acc"] is None
+    assert validate_file(tel.path) == []
+
+
+def test_maybe_create_returns_none_on_unwritable_logdir(tmp_path, capsys):
+    """A stream that failed to open must not be announced or handed to
+    the caller as if it existed."""
+    from commefficient_tpu.telemetry import maybe_create
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a file where the logdir should go")
+    cfg = FedConfig()
+    assert maybe_create(cfg, "test", logdir=str(blocker)) is None
+    assert "telemetry:" not in capsys.readouterr().err
+    # and the disabled-config path still returns None
+    assert maybe_create(cfg.replace(telemetry=False), "test",
+                        logdir=str(tmp_path)) is None
+
+
+def test_validator_seq_resync_no_cascade():
+    """One seq gap (or stray non-object line) is one problem, not a
+    mismatch on every following line."""
+    def ev(seq, n):
+        return json.dumps({"event": "memory", "t": 0.0, "seq": seq,
+                           "phase": f"p{n}", "devices": [],
+                           "host_rss_bytes": None})
+    man = json.dumps({"event": "manifest", "t": 0.0, "seq": 0, "schema": 1,
+                      "run_type": "t", "jax_version": "x", "backend": "cpu",
+                      "device_kind": "cpu", "device_count": 1,
+                      "mesh_shape": [], "mesh_axes": [], "grad_size": 1,
+                      "sketch": None, "config": {}})
+    # a gap 0 -> 5 flags exactly once; 5,6,7 then validate cleanly
+    probs = validate_lines([man, ev(5, 1), ev(6, 2), ev(7, 3)])
+    assert len([p for _, p in probs if "seq" in p]) == 1
+    # a stray non-object line flags itself; the writer's own seq stream
+    # continues undisturbed around it
+    probs = validate_lines([man, "[1, 2]", ev(1, 1), ev(2, 2)])
+    assert not any("seq" in p for _, p in probs)
+    assert any("not an object" in p for _, p in probs)
+
+
+def test_set_compile_watcher_idempotent(tmp_path):
+    """A second instrument() call must not double-wrap (the wrapper needs
+    the raw jitted fn's AOT surface) — compile events keep flowing."""
+    rt = make_runtime()
+    tel = RunTelemetry(str(tmp_path), "test", cfg=rt.cfg)
+    tel.instrument(rt)
+    tel.instrument(rt)  # no-op, not a re-wrap
+    batch, mask, ids = make_batch()
+    state = rt.init_state()
+    state, _ = rt.round(state, ids, batch, mask, 0.05)
+    tel.close()
+    comp = [e for e in read_events(tel.path) if e["event"] == "compile"]
+    assert len(comp) == 1 and comp[0]["fallback"] is False
