@@ -269,6 +269,29 @@ class FedConfig:
     # otherwise (~5% overhead worst case instead of several-fold). Set 1
     # explicitly for convergence studies where per-round curves matter.
     telemetry_every: int = -1
+    # compression-signal health diagnostics (telemetry/signals.py):
+    # cheap on-device norms (aggregated gradient, EF accumulators,
+    # update support, sketch collision proxies) computed inside the
+    # jitted round and emitted as `signals` telemetry events at the
+    # --telemetry_every cadence. --no_signals drops them from the round
+    # step entirely (they cost a handful of fused reductions per round,
+    # plus two table-sized all-gathers in mesh sketch mode); they are
+    # also auto-dropped under --no_telemetry, which leaves no consumer.
+    signals: bool = True
+    # heavy-hitter recovery quality (topk_overlap): compares the
+    # decompressed update's support against the exact top-k of the DENSE
+    # error — needs a dense reference, so it is opt-in: true_topk /
+    # dense-preimage sketch reconstruct it from existing state (one extra
+    # O(d) top-k per round); table-state sketch additionally carries a
+    # dense shadow error accumulator (2 x O(d) state, single-device
+    # deferred-encode only)
+    signals_exact: bool = False
+    # fail (instead of warn) on configurations round 5 MEASURED divergent
+    # — see core/server.py check_regime_health: local_topk with local
+    # error feedback at dense-stable lr, subtract-EF at high collision
+    # load. The measurements: runs/README.md (local_topk envelope),
+    # runs/gpt2_conv/README.md (subtract dose-response)
+    strict_regimes: bool = False
     # persistent XLA compilation cache directory: the GPT-2-scale federated
     # round compiles in ~10 min cold — pay it once per machine, not per run
     compilation_cache_dir: str = "~/.cache/commefficient_tpu_xla"
@@ -574,6 +597,19 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                         "(each record syncs the round's metrics to host; "
                         "0 = none, -1 = auto: 1 under --test, 64 "
                         "otherwise)")
+    p.add_argument("--no_signals", dest="signals", action="store_false",
+                   default=True,
+                   help="drop the per-round compression-signal health "
+                        "diagnostics from the jitted round step")
+    p.add_argument("--signals_exact", action="store_true",
+                   help="compute topk_overlap (heavy-hitter recovery vs "
+                        "the exact dense error top-k); adds an O(d) "
+                        "top-k per round, and a dense shadow error "
+                        "accumulator for table-state sketch")
+    p.add_argument("--strict_regimes", action="store_true",
+                   help="fail at startup (instead of warning) on "
+                        "configurations measured divergent in round 5 "
+                        "(see core/server.py check_regime_health)")
     p.add_argument("--compilation_cache_dir", type=str,
                    default="~/.cache/commefficient_tpu_xla",
                    help="persistent XLA compile cache; empty disables")

@@ -32,10 +32,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from commefficient_tpu.config import FedConfig
 from commefficient_tpu.core import client as client_lib
-from commefficient_tpu.core.server import server_update, validate_mode_combo
+from commefficient_tpu.core.server import (server_update,
+                                           validate_mode_combo,
+                                           validate_regimes)
 from commefficient_tpu.core.state import FedState
 from commefficient_tpu.ops import ravel_params
 from commefficient_tpu.ops.sketch import make_sketch_impl
+from commefficient_tpu.telemetry.signals import round_signals
 from commefficient_tpu.utils.jax_compat import shard_map
 
 
@@ -77,6 +80,11 @@ class FedRuntime:
                       "--exact_num_cols pins the original)")
                 cfg = cfg.replace(num_cols=c)
         validate_mode_combo(cfg)
+        # measured-divergence guardrails (VERDICT r5 weak #3): warn — or
+        # fail under --strict_regimes — on configs round 5 measured
+        # divergent; runs here (not parse time) because the collision
+        # load needs the resolved grad_size/num_cols
+        validate_regimes(cfg)
         self.cfg = cfg
         self.unravel = unravel
         self.initial_weights = flat
@@ -225,6 +233,28 @@ class FedRuntime:
                 "--sketch_server_state dense requires a single device "
                 "(no mesh) and deferred encode (no per-client table "
                 "clip — use --sketch_dense_clip to clip)")
+        # compression-signal health diagnostics (telemetry/signals.py):
+        # cheap on-device reductions appended to the round's metrics.
+        # Gated on telemetry too: with --no_telemetry nothing ever reads
+        # them, and in sketch mode on a mesh the l2estimate diagnostics
+        # cost two table-sized all-gathers per round — never pay a hot-
+        # path collective for a stream nobody consumes.
+        self._signals = cfg.signals and cfg.telemetry
+        # the dense pre-encode aggregate exists only where the deferred
+        # encode runs once on one device — capture it there so sketch
+        # mode gets grad_true_norm (the collision-noise reference); on a
+        # mesh each shard encodes its own partial sum and the global
+        # dense aggregate never materializes (by design — restoring it
+        # would cost the d-sized collective the encode deferral removes)
+        self._signals_dense_cap = (self._signals and cfg.mode == "sketch"
+                                   and self._defer_encode
+                                   and not self._dense_preimage
+                                   and mesh is None)
+        # --signals_exact on TABLE-state sketch additionally threads a
+        # dense shadow EF accumulator pair through FedState (see
+        # signals.py round_signals) — same availability condition
+        self._signals_shadow = (self._signals_dense_cap
+                                and cfg.signals_exact)
 
         loss_fn_val = loss_fn_val if loss_fn_val is not None else loss_fn_train
         # Fused client gradients: when nothing nonlinear happens per client
@@ -407,6 +437,8 @@ class FedRuntime:
             client_last_round=(jnp.zeros((n,), jnp.int32)
                                if cfg.track_bytes else None),
             nan_round=jnp.full((), -1, jnp.int32),
+            sig_Vvelocity=maybe((d,), self._signals_shadow),
+            sig_Verror=maybe((d,), self._signals_shadow),
         )
 
     # ------------------------------------------------------------- round step
@@ -526,7 +558,14 @@ class FedRuntime:
                 if wire and not self._defer_encode and tx.ndim == 3:
                     tx = tx.astype(td).astype(jnp.float32)
                 agg = tx.sum(axis=0)
+            sig_dense = None
             if self._defer_encode and not self._dense_preimage:
+                if self._signals_dense_cap:
+                    # keep the dense summed gradient alive for the signal
+                    # norms/shadow (single device only — the buffer
+                    # already exists here, this just extends its lifetime
+                    # to the round step's tail)
+                    sig_dense = agg
                 agg = cs.encode(agg)
             if wire and self._axis is None and agg.ndim == 2:
                 agg = agg.astype(td).astype(jnp.float32)
@@ -599,7 +638,7 @@ class FedRuntime:
                 if err_out is not None:
                     err_out = rows_to_home(err_out)
             return agg, n_total, vel_out, err_out, out.results, \
-                out.n_valid
+                out.n_valid, sig_dense
 
         if self._axis is not None:
             ax = self._axis
@@ -633,6 +672,7 @@ class FedRuntime:
                 row_spec if (cfg.mode != "fedavg" and has_err) else None,
                 tuple(row for _ in range(cfg.num_results_train)),
                 row,
+                None,   # sig_dense: never captured on a mesh (see __init__)
             )
             # check_vma off: the client step's scan carries start as
             # replicated zeros and become device-varying on the first
@@ -641,12 +681,15 @@ class FedRuntime:
                                      in_specs=in_specs, out_specs=out_specs,
                                      check_vma=False)
 
-        agg, n_total, vel_new, err_new, results, n_valid = client_block(
-            used_weights, batch, mask, vel_rows, err_rows, client_rngs, lr,
-            cs)
+        agg, n_total, vel_new, err_new, results, n_valid, sig_dense = \
+            client_block(used_weights, batch, mask, vel_rows, err_rows,
+                         client_rngs, lr, cs)
         out = client_lib.ClientOut(None, vel_new, err_new, results, n_valid)
         total = jnp.maximum(n_total, 1.0)
         agg = agg / total
+        if sig_dense is not None:
+            # same normalization as agg: the signals compare like with like
+            sig_dense = sig_dense / total
 
         # ---- server update
         server_lr = jnp.asarray(1.0) if cfg.mode == "fedavg" else lr
@@ -659,6 +702,20 @@ class FedRuntime:
             cfg, agg, state.Vvelocity, state.Verror, server_lr,
             cs=cs, dp_rng=server_rng,
             dense_preimage=self._dense_preimage)
+
+        # ---- compression-signal health (telemetry/signals.py): on-device
+        # scalars fetched asynchronously alongside the loss — computed
+        # BEFORE the update is padded so true-d slicing stays uniform
+        signals = None
+        sig_vel_new, sig_err_new = state.sig_Vvelocity, state.sig_Verror
+        if self._signals:
+            signals, sig_vel_new, sig_err_new = round_signals(
+                cfg, agg=agg, update=update,
+                Vvel_prev=state.Vvelocity, Verr_prev=state.Verror,
+                Vvel_new=Vvel, Verr_new=Verr, cs=cs,
+                dense_agg=sig_dense,
+                sig_vel=state.sig_Vvelocity, sig_err=state.sig_Verror)
+
         if self.d_pad != cfg.grad_size:
             if update.shape[0] == cfg.grad_size:
                 # sketch decode produces a true-d update; pad to the
@@ -719,12 +776,15 @@ class FedRuntime:
             coord_last_update=coord_last_update,
             client_last_round=client_last_round,
             nan_round=nan_round,
+            sig_Vvelocity=sig_vel_new,
+            sig_Verror=sig_err_new,
         )
         metrics = {
             "results": out.results,          # tuple of (num_workers,) arrays
             "n_valid": out.n_valid,
             "download_bytes": download_bytes,
             "upload_bytes": upload_bytes,
+            "signals": signals,              # dict of scalars, or None
         }
         return new_state, metrics
 

@@ -48,3 +48,14 @@ class FedState:
     # the exact offending round — and lets drivers refuse to checkpoint
     # poisoned state.
     nan_round: Optional[jax.Array] = None          # () int32, init -1
+    # --signals_exact dense shadow EF accumulators for table-state sketch
+    # (telemetry/signals.py): what an exact-state server would hold, so
+    # the heavy-hitter recovery overlap has a dense reference. Allocated
+    # only single-device with deferred encode (the only place the dense
+    # summed gradient exists); diagnostics-only — never feeds the update.
+    # A checkpoint written without them restores None; the drivers
+    # (cv_train.setup_checkpointing) re-zero them on resume when the
+    # runtime expects a shadow, so the shadow (not the run) restarts
+    # from zero instead of the signal silently going dead.
+    sig_Vvelocity: Optional[jax.Array] = None      # (d,) fp32
+    sig_Verror: Optional[jax.Array] = None         # (d,) fp32

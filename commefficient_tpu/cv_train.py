@@ -150,6 +150,15 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
                       f"({saved_gen!r} -> {sketch_gen!r}); momentum/error "
                       "tables RESET, resuming from weights only",
                       file=sys.stderr)
+            if runtime._signals_shadow and restored.sig_Verror is None:
+                # checkpoints written before the --signals_exact shadow
+                # accumulators existed (or with signals off) restore
+                # None here; re-zero them so the topk_overlap signal
+                # stays LIVE on the resumed run — the shadow (not the
+                # run) restarts from zero, as core/state.py documents
+                zeros = jnp.zeros((runtime.cfg.grad_size,), jnp.float32)
+                restored = restored.replace(sig_Vvelocity=zeros,
+                                            sig_Verror=jnp.zeros_like(zeros))
             start = int(meta.get("epoch", 0))
             print(f"resumed from epoch {start}")
             return mgr, start, restored
@@ -335,20 +344,39 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                     nv = np.asarray(metrics["n_valid"], np.float64)
                     tot = max(float(nv.sum()), 1.0)
                     acc_idx = 1 if len(res) > 1 else 0
+                    down_total = up_total = None
+                    down_clients = up_clients = None
+                    if cfg.track_bytes:
+                        # exact per-client byte costs: the round metrics
+                        # scatter them at client_ids over (num_clients,)
+                        down_all = np.asarray(metrics["download_bytes"])
+                        up_all = np.asarray(metrics["upload_bytes"])
+                        down_total = float(down_all.sum())
+                        up_total = float(up_all.sum())
+                        ids = np.asarray(rnd.client_ids)
+                        down_clients = [float(x) for x in down_all[ids]]
+                        up_clients = [float(x) for x in up_all[ids]]
                     telemetry.round_event(
                         rnd=global_round, epoch=epoch + 1, lr=float(lr),
                         loss=float((res[0] * nv).sum() / tot),
                         acc=float((res[acc_idx] * nv).sum() / tot),
                         n_valid=float(nv.sum()),
-                        download_bytes=(
-                            float(np.asarray(
-                                metrics["download_bytes"]).sum())
-                            if cfg.track_bytes else None),
-                        upload_bytes=(
-                            float(np.asarray(metrics["upload_bytes"]).sum())
-                            if cfg.track_bytes else None),
+                        download_bytes=down_total,
+                        upload_bytes=up_total,
                         host_s=t_host - t_loop, dispatch_s=t_dispatch - t_host,
                         device_s=t_device - t_dispatch)
+                    if metrics.get("signals"):
+                        # compression-signal health, same cadence / same
+                        # host sync as the round record (signals.py)
+                        from commefficient_tpu.telemetry import \
+                            signals_to_host
+                        telemetry.signals_event(
+                            rnd=global_round, mode=cfg.mode,
+                            signals=signals_to_host(metrics["signals"]),
+                            download_bytes=down_total,
+                            upload_bytes=up_total,
+                            client_download_bytes=down_clients,
+                            client_upload_bytes=up_clients)
                 rounds_run += 1
                 if telemetry is not None and rounds_run == 1:
                     # device memory after the first round: weights + server

@@ -1,9 +1,15 @@
 """Run-telemetry subsystem: structured per-round metrics, compile and
-memory observability, and profiler window management — shared by
-``cv_train.py``, ``gpt2_train.py``, ``bench.py`` and ``bench_gpt2.py``.
-See schema.py for the JSONL event schema and README.md ("Telemetry &
-profiling") for the consumer-facing contract."""
+memory observability, compression-signal health (signals.py), the HLO
+collective ledger (collectives.py) and profiler window management —
+shared by ``cv_train.py``, ``gpt2_train.py``, ``bench.py`` and
+``bench_gpt2.py``. See schema.py for the JSONL event schema and
+README.md ("Telemetry & profiling") for the consumer-facing contract;
+``scripts/teleview.py`` summarizes and diffs the streams offline."""
 
+from commefficient_tpu.telemetry.collectives import (ledger_from_compiled,
+                                                     ledger_from_hlo,
+                                                     round_ledger,
+                                                     summarize_ledger)
 from commefficient_tpu.telemetry.compilewatch import JitWatcher
 from commefficient_tpu.telemetry.profiling import (ProfilerWindow,
                                                    parse_profile_rounds)
@@ -13,6 +19,8 @@ from commefficient_tpu.telemetry.schema import (SCHEMA_VERSION,
                                                 validate_event,
                                                 validate_file,
                                                 validate_lines)
+from commefficient_tpu.telemetry.signals import (SIGNAL_KEYS, round_signals,
+                                                 signals_to_host)
 
 __all__ = [
     "JitWatcher",
@@ -25,4 +33,11 @@ __all__ = [
     "validate_event",
     "validate_file",
     "validate_lines",
+    "SIGNAL_KEYS",
+    "round_signals",
+    "signals_to_host",
+    "ledger_from_hlo",
+    "ledger_from_compiled",
+    "round_ledger",
+    "summarize_ledger",
 ]

@@ -90,6 +90,17 @@ class JitWatcher:
                 cache[key] = compiled
                 emit(len(cache), t1 - t0, t2 - t1,
                      _cost_analysis(compiled))
+                # collective ledger of the fresh executable (count/kind/
+                # bytes of every cross-device collective) — best-effort,
+                # like every observability path here
+                if hasattr(self._telemetry, "collectives_event"):
+                    try:
+                        from commefficient_tpu.telemetry.collectives import \
+                            ledger_from_compiled
+                        self._telemetry.collectives_event(
+                            name, ledger_from_compiled(compiled))
+                    except Exception:
+                        pass
             try:
                 return compiled(*args)
             except Exception:

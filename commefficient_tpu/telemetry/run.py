@@ -248,6 +248,29 @@ class RunTelemetry:
     def bench_event(self, metric: str, result: Dict[str, Any]) -> None:
         self.event("bench", metric=metric, result=result)
 
+    def signals_event(self, *, rnd: int, mode: str,
+                      signals: Dict[str, Any],
+                      download_bytes: Optional[float] = None,
+                      upload_bytes: Optional[float] = None,
+                      client_download_bytes=None,
+                      client_upload_bytes=None) -> None:
+        """Compression-signal health for one round (telemetry/signals.py
+        computes the dict on device; the driver fetches it at the same
+        cadence as the round record). Non-finite values — the NaN used
+        for not-applicable signals — serialize as null via _jsonable."""
+        self.event("signals", round=rnd, mode=mode, **signals,
+                   download_bytes=download_bytes, upload_bytes=upload_bytes,
+                   client_download_bytes=client_download_bytes,
+                   client_upload_bytes=client_upload_bytes)
+
+    def collectives_event(self, name: str, ledger) -> None:
+        """Collective inventory of one compiled executable — emitted by
+        the JitWatcher next to each `compile` event, so a count
+        regression (the 32x all_to_all unroll class) shows in every
+        run's stream."""
+        from commefficient_tpu.telemetry.collectives import summarize_ledger
+        self.event("collectives", name=name, **summarize_ledger(ledger))
+
     def write_summary(self, *, aborted: bool, n_rounds: int,
                       total_download_mib: Optional[float] = None,
                       total_upload_mib: Optional[float] = None,

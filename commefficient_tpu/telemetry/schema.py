@@ -56,6 +56,10 @@ def _list(v: Any) -> bool:
     return isinstance(v, list)
 
 
+def _opt_list(v: Any) -> bool:
+    return v is None or isinstance(v, list)
+
+
 # event type -> {required field: predicate}. The envelope (event/t/seq)
 # is checked for every line before the per-type fields.
 EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
@@ -136,6 +140,41 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "metric": _str,
         "result": _dict,
     },
+    # compression-signal health for one round (telemetry/signals.py):
+    # on-device norms of the aggregated gradient / EF accumulators /
+    # applied update, sketch collision-noise proxies, heavy-hitter
+    # recovery overlap, and exact per-client byte costs. Norm fields are
+    # null when not applicable to the mode/topology (e.g. no dense
+    # pre-image on a mesh) — never silently zero
+    "signals": {
+        "round": _int,
+        "mode": _str,
+        "grad_norm": _opt_num,
+        "grad_true_norm": _opt_num,     # dense preimage norm, if one exists
+        "grad_l2estimate": _opt_num,    # sketch table norm estimate
+        "velocity_norm": _opt_num,
+        "error_norm": _opt_num,
+        "error_l2estimate": _opt_num,
+        "update_norm": _opt_num,
+        "support_density": _opt_num,
+        "topk_overlap": _opt_num,       # --signals_exact only, else null
+        "download_bytes": _opt_num,     # round totals; null w/o track_bytes
+        "upload_bytes": _opt_num,
+        "client_download_bytes": _opt_list,  # per participating client,
+        "client_upload_bytes": _opt_list,    # ordered by client_ids
+    },
+    # collective inventory of one compiled executable (telemetry/
+    # collectives.py): per-kind LAUNCH counts, total payload bytes and
+    # the per-element op list — emitted next to each `compile` event so
+    # a collective-count regression (the round-5 32x all_to_all unroll
+    # class) is visible in every run's stream, not only in the dryruns
+    "collectives": {
+        "name": _str,                   # watched function (round_step, ...)
+        "n_collectives": _int,          # total launches
+        "counts": _dict,                # kind -> launch count
+        "total_bytes": _num,
+        "ops": _list,                   # [{kind, n_elements, dtype, bytes,
+    },                                  #   combined_in}, ...]
     # end-of-run footer
     "summary": {
         "run_type": _str,

@@ -9,6 +9,12 @@ drifts from the documented schema fails CI instead of silently rotting.
 Usage:
     python scripts/check_telemetry_schema.py [root ...]
     python scripts/check_telemetry_schema.py path/to/telemetry.jsonl
+    python scripts/check_telemetry_schema.py --selftest
+
+``--selftest`` generates a sample stream containing one event of EVERY
+schema type (signals and collectives included) and validates it — the
+cheap CI proof that the generator vocabulary and the validator
+vocabulary have not drifted apart.
 
 Exit status: 0 when every stream found is valid (or none exist),
 1 when any stream has problems, 2 on usage errors.
@@ -16,13 +22,53 @@ Exit status: 0 when every stream found is valid (or none exist),
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from commefficient_tpu.telemetry.schema import (TELEMETRY_BASENAME,  # noqa: E402
-                                                validate_file)
+from commefficient_tpu.telemetry.schema import (EVENT_FIELDS,  # noqa: E402
+                                                SCHEMA_VERSION,
+                                                TELEMETRY_BASENAME,
+                                                validate_file,
+                                                validate_lines)
+
+# minimal valid value per predicate-shaped field, keyed by the exact
+# field name where a generic fill would be wrong
+_SAMPLE_OVERRIDES = {
+    "schema": SCHEMA_VERSION,
+    "devices": [{"id": 0, "kind": "cpu", "stats": None}],
+    "ops": [{"kind": "all-reduce", "n_elements": 192, "dtype": "f32",
+             "bytes": 768, "combined_in": 0}],
+    "counts": {"all-reduce": 1},
+    "client_download_bytes": [4.0],
+    "client_upload_bytes": [4.0],
+}
+
+
+def _sample_value(field, pred):
+    if field in _SAMPLE_OVERRIDES:
+        return _SAMPLE_OVERRIDES[field]
+    name = pred.__name__
+    return {"_int": 1, "_num": 1.0, "_opt_num": 1.0, "_str": "x",
+            "_bool": False, "_dict": {}, "_opt_dict": None,
+            "_list": [], "_opt_list": []}.get(name, None)
+
+
+def sample_stream():
+    """One well-formed JSONL line per schema event type, manifest first,
+    summary last, contiguous seq — a synthetic but schema-complete run."""
+    order = (["manifest"]
+             + [k for k in EVENT_FIELDS if k not in ("manifest", "summary")]
+             + ["summary"])
+    lines = []
+    for seq, kind in enumerate(order):
+        ev = {"event": kind, "t": float(seq), "seq": seq}
+        for field, pred in EVENT_FIELDS[kind].items():
+            ev[field] = _sample_value(field, pred)
+        lines.append(json.dumps(ev))
+    return lines
 
 
 def find_streams(roots):
@@ -37,7 +83,22 @@ def find_streams(roots):
 
 
 def main(argv=None) -> int:
-    roots = (argv if argv is not None else sys.argv[1:]) or ["runs"]
+    args = list(argv if argv is not None else sys.argv[1:])
+    selftest = "--selftest" in args
+    if selftest:
+        # the flag composes with roots in any order; run it first and
+        # keep linting whatever paths remain
+        args = [a for a in args if a != "--selftest"]
+        problems = validate_lines(sample_stream())
+        for lineno, problem in problems:
+            print(f"selftest line {lineno}: {problem}")
+        print(f"selftest: {len(EVENT_FIELDS)} event types "
+              f"{'INVALID' if problems else 'ok'}")
+        if problems:
+            return 1
+        if not args:
+            return 0
+    roots = args or ["runs"]
     for root in roots:
         if not os.path.exists(root):
             print(f"check_telemetry_schema: {root} does not exist",

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""teleview: offline analyzer for ``telemetry.jsonl`` streams.
+
+BENCH/MULTICHIP comparisons have been manual JSON spelunking — ``jq``
+one-liners against artifacts whose schema only the writers knew. This
+CLI reads one stream (``summarize``) or two (``diff``) and turns them
+into the three tables that actually answer "did this run regress":
+
+    python scripts/teleview.py summarize runs/x/telemetry.jsonl
+    python scripts/teleview.py diff old/telemetry.jsonl new/telemetry.jsonl
+
+``summarize`` prints the manifest header, compile/collective inventory
+(per watched executable: launch counts by kind, payload bytes), a
+sampled round table, per-signal trends (first/last/min/max of every
+signals.py key) and the epoch table.
+
+``diff`` compares two runs and EXITS NONZERO on regression:
+- any collective launch-count increase for a watched executable (the
+  round-5 32x all_to_all unroll class — count growth is never benign),
+  or payload-byte growth beyond ``--bytes_ratio``;
+- a final signal norm (error/velocity/update/grad) growing beyond
+  ``--signal_ratio``x (sketch-EF divergence shows here rounds before
+  the loss goes non-finite), or topk_overlap dropping by more than
+  ``--overlap_drop``;
+- the final round/epoch loss growing beyond ``--loss_ratio``x.
+
+Dependency-free (json + argparse), validates nothing itself — run
+``scripts/check_telemetry_schema.py`` for schema enforcement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    # single source of truth when the package is importable...
+    from commefficient_tpu.telemetry.schema import TELEMETRY_BASENAME
+    from commefficient_tpu.telemetry.signals import SIGNAL_KEYS
+except ImportError:
+    # ...but the analyzer must work on a machine WITHOUT jax (analyzing
+    # a downloaded artifact is the whole point of an offline tool, and
+    # the telemetry package import pulls jax in transitively). These
+    # literals mirror the canonical values; tests/test_signals.py pins
+    # them against the package.
+    TELEMETRY_BASENAME = "telemetry.jsonl"
+    SIGNAL_KEYS = (
+        "grad_norm", "grad_true_norm", "grad_l2estimate",
+        "velocity_norm", "error_norm", "error_l2estimate",
+        "update_norm", "support_density", "topk_overlap",
+    )
+
+NORM_KEYS = ("grad_norm", "grad_true_norm", "grad_l2estimate",
+             "velocity_norm", "error_norm", "error_l2estimate",
+             "update_norm")
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    if os.path.isdir(path):
+        path = os.path.join(path, TELEMETRY_BASENAME)
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue  # check_telemetry_schema flags these; keep reading
+            if isinstance(obj, dict):
+                events.append(obj)
+    return events
+
+
+def by_kind(events, kind):
+    return [e for e in events if e.get("event") == kind]
+
+
+def latest_collectives(events) -> Dict[str, Dict[str, Any]]:
+    """name -> the LAST collectives event per watched executable (a
+    recompile re-emits; the last one is the executable that ran)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in by_kind(events, "collectives"):
+        out[str(e.get("name"))] = e
+    return out
+
+
+def _fin(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+# ------------------------------------------------------------------ summarize
+
+
+def summarize(events: List[Dict[str, Any]], label: str = "") -> None:
+    man = next(iter(by_kind(events, "manifest")), {})
+    cfgd = man.get("config") or {}
+    print(f"== {label or 'run'}: {man.get('run_type', '?')} on "
+          f"{man.get('device_count', '?')}x {man.get('device_kind', '?')} "
+          f"({man.get('backend', '?')}, jax {man.get('jax_version', '?')})")
+    sk = man.get("sketch")
+    print(f"   mode={cfgd.get('mode', '?')} grad_size={man.get('grad_size')}"
+          + (f" sketch={sk['impl']} {sk['num_rows']}x{sk['num_cols']} "
+             f"k={sk['k']} ef={sk['ef']}" if sk else ""))
+
+    comps = by_kind(events, "compile")
+    if comps:
+        print("-- compiles")
+        for e in comps:
+            print(f"   {e['name']}: #{e['n_compiles']} "
+                  f"lower {e['lower_s']:.2f}s compile {e['compile_s']:.2f}s"
+                  + (f" flops {e['flops']:.3g}" if e.get("flops") else "")
+                  + (" FALLBACK" if e.get("fallback") else ""))
+
+    colls = latest_collectives(events)
+    if colls:
+        print("-- collectives (per compiled executable)")
+        for name, e in sorted(colls.items()):
+            counts = e.get("counts") or {}
+            inv = " ".join(f"{k}x{v}" for k, v in sorted(counts.items()))
+            print(f"   {name}: {e.get('n_collectives', 0)} launches"
+                  f" [{inv or 'none'}] payload "
+                  f"{(e.get('total_bytes') or 0) / 1024:.1f} KiB")
+
+    rounds = by_kind(events, "round")
+    if rounds:
+        losses = [_fin(e.get("loss")) for e in rounds]
+        fin = [l for l in losses if l is not None]
+        print(f"-- rounds: {len(rounds)} records, loss "
+              f"first {fin[0]:.4f} last {fin[-1]:.4f} min {min(fin):.4f}"
+              if fin else f"-- rounds: {len(rounds)} records (no finite loss)")
+        step = max(1, len(rounds) // 8)
+        for e in rounds[::step]:
+            print(f"   r{e['round']:>6} ep{e['epoch']:>3} "
+                  f"lr {e['lr']:.4f} loss "
+                  + (f"{e['loss']:.4f}" if _fin(e.get("loss")) is not None
+                     else "NaN")
+                  + f" host {e['host_s']*1e3:.0f}ms dev "
+                    f"{e['device_s']*1e3:.0f}ms")
+
+    sigs = by_kind(events, "signals")
+    if sigs:
+        print(f"-- signals: {len(sigs)} records")
+        for key in SIGNAL_KEYS:
+            vals = [_fin(e.get(key)) for e in sigs]
+            vals = [v for v in vals if v is not None]
+            if not vals:
+                continue
+            print(f"   {key:18s} first {vals[0]:11.5g} last {vals[-1]:11.5g}"
+                  f" min {min(vals):11.5g} max {max(vals):11.5g}")
+
+    epochs = by_kind(events, "epoch")
+    if epochs:
+        print("-- epochs")
+
+        def fmt(v, spec=".4f"):
+            # loss/acc fields are nullable (non-finite serializes as null)
+            return format(v, spec) if _fin(v) is not None else "NaN"
+
+        for e in epochs:
+            print(f"   ep{e['epoch']:>3} train {fmt(e['train_loss'])}/"
+                  f"{fmt(e['train_acc'])} test {fmt(e['test_loss'])}/"
+                  f"{fmt(e['test_acc'])} up {fmt(e['upload_mib'], '.0f')}"
+                  " MiB")
+
+    summ = next(iter(by_kind(events, "summary")), None)
+    if summ is None:
+        print("-- NO summary footer: the run DIED before finishing")
+    else:
+        print(f"-- summary: {'ABORTED' if summ['aborted'] else 'ok'}, "
+              f"{summ['n_rounds']} rounds, {summ['wall_time_s']:.1f}s wall")
+    for e in by_kind(events, "nan_abort"):
+        print(f"   nan_abort at round {e['nan_round']}: {e['reason']}")
+
+
+# ----------------------------------------------------------------------- diff
+
+
+def diff(a: List[Dict[str, Any]], b: List[Dict[str, Any]],
+         args) -> List[str]:
+    """Regressions of run B against baseline A (empty list = clean)."""
+    problems: List[str] = []
+
+    ca, cb = latest_collectives(a), latest_collectives(b)
+    for name in sorted(set(ca) & set(cb)):
+        counts_a = ca[name].get("counts") or {}
+        counts_b = cb[name].get("counts") or {}
+        for kind in sorted(set(counts_a) | set(counts_b)):
+            na, nb = counts_a.get(kind, 0), counts_b.get(kind, 0)
+            if nb > na + args.count_slack:
+                problems.append(
+                    f"collectives[{name}]: {kind} launch count {na} -> {nb}"
+                    " (count growth is the 32x-unroll regression class)")
+        ba = ca[name].get("total_bytes") or 0
+        bb = cb[name].get("total_bytes") or 0
+        if ba > 0 and bb > ba * args.bytes_ratio:
+            problems.append(
+                f"collectives[{name}]: payload bytes {ba} -> {bb} "
+                f"(> {args.bytes_ratio:.2f}x)")
+
+    sa, sb = by_kind(a, "signals"), by_kind(b, "signals")
+    if sa and sb:
+        for key in NORM_KEYS:
+            va, vb = _fin(sa[-1].get(key)), _fin(sb[-1].get(key))
+            if va is not None and vb is not None and va > 0 \
+                    and vb > va * args.signal_ratio:
+                problems.append(
+                    f"signals: final {key} {va:.5g} -> {vb:.5g} "
+                    f"(> {args.signal_ratio:.2f}x — EF-divergence class)")
+        oa, ob = (_fin(sa[-1].get("topk_overlap")),
+                  _fin(sb[-1].get("topk_overlap")))
+        if oa is not None and ob is not None \
+                and ob < oa - args.overlap_drop:
+            problems.append(
+                f"signals: topk_overlap {oa:.3f} -> {ob:.3f} "
+                f"(drop > {args.overlap_drop:.2f} — recovery degraded)")
+
+    def final_loss(events):
+        eps = by_kind(events, "epoch")
+        if eps:
+            return _fin(eps[-1].get("test_loss"))
+        rnds = [_fin(e.get("loss")) for e in by_kind(events, "round")]
+        rnds = [v for v in rnds if v is not None]
+        return rnds[-1] if rnds else None
+
+    la, lb = final_loss(a), final_loss(b)
+    if la is not None:
+        if lb is None:
+            problems.append("loss: baseline finite, new run has no finite "
+                            "loss (diverged?)")
+        elif la > 0 and lb > la * args.loss_ratio:
+            problems.append(f"loss: final {la:.4f} -> {lb:.4f} "
+                            f"(> {args.loss_ratio:.2f}x)")
+    for e in by_kind(b, "nan_abort"):
+        if not by_kind(a, "nan_abort"):
+            problems.append(f"new run aborted non-finite at round "
+                            f"{e['nan_round']} (baseline did not)")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="teleview")
+    sub = ap.add_subparsers(dest="cmd")
+    s = sub.add_parser("summarize", help="one-stream report")
+    s.add_argument("path")
+    d = sub.add_parser("diff", help="regression check: B against baseline A")
+    d.add_argument("baseline")
+    d.add_argument("candidate")
+    d.add_argument("--count_slack", type=int, default=0,
+                   help="collective launch-count growth tolerated (default "
+                        "0: any increase fails)")
+    d.add_argument("--bytes_ratio", type=float, default=1.05,
+                   help="max collective payload-byte growth factor")
+    d.add_argument("--signal_ratio", type=float, default=2.0,
+                   help="max final signal-norm growth factor")
+    d.add_argument("--overlap_drop", type=float, default=0.2,
+                   help="max topk_overlap absolute drop")
+    d.add_argument("--loss_ratio", type=float, default=1.05,
+                   help="max final loss growth factor")
+    args = ap.parse_args(argv)
+    if args.cmd == "summarize":
+        summarize(load_events(args.path), label=args.path)
+        return 0
+    if args.cmd == "diff":
+        a, b = load_events(args.baseline), load_events(args.candidate)
+        summarize(a, label=f"A (baseline) {args.baseline}")
+        summarize(b, label=f"B (candidate) {args.candidate}")
+        problems = diff(a, b, args)
+        if problems:
+            print("== REGRESSIONS")
+            for p in problems:
+                print(f"   {p}")
+            return 1
+        print("== no regressions beyond thresholds")
+        return 0
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,6 +122,7 @@ def _write_bpe_fixture(d):
     return d
 
 
+@pytest.mark.slow
 def test_persona_real_corpus_with_real_bpe(tmp_path):
     """The real-corpus branch (reference fed_persona.py:23-28, 31-392) +
     the real GPT-2 BPE tokenizer branch (get_tokenizer, reference

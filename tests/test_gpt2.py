@@ -53,6 +53,7 @@ def test_causality(tiny_model):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_losses_finite_and_trainable(tiny_model):
     cfg, model, params = tiny_model
     rng = np.random.RandomState(1)
@@ -79,6 +80,7 @@ def test_losses_finite_and_trainable(tiny_model):
     assert np.isfinite(float(nll))
 
 
+@pytest.mark.slow
 def test_chunked_lm_loss_matches_dense(tiny_model):
     """lm_chunk (the memory-bounded CE that never materializes full-vocab
     logits — the microbatch-8 enabler) must reproduce the dense loss AND

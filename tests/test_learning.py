@@ -62,6 +62,7 @@ def run_training(mode, extra, tmp_path, epochs=10, lr=0.15):
     ("sketch", {"error_type": "virtual", "k": 2000, "num_rows": 3,
                 "num_cols": 20000, "num_blocks": 2}),
 ])
+@pytest.mark.slow
 def test_training_learns(mode, extra, tmp_path):
     losses = run_training(mode, extra, tmp_path)
     assert np.isfinite(losses).all(), losses
@@ -145,6 +146,7 @@ def test_rht_compressing_regime_is_rejected(capsys):
     assert "diverges" not in captured.out
 
 
+@pytest.mark.slow
 def test_imagenet_pipeline_end_to_end_rounds(tmp_path):
     """FedImageNet's synthetic path through real federated rounds (not
     just prepare/ingest): per-wnid natural clients, sampler, sketch
@@ -185,6 +187,7 @@ def test_imagenet_pipeline_end_to_end_rounds(tmp_path):
     assert np.isfinite(float(res[0]))
 
 
+@pytest.mark.slow
 def test_flagship_model_trains_at_real_compression(tmp_path):
     """VERDICT r2 item 7: the compressing-regime stability claim must
     cover the flagship PATH, not just a quadratic toy — the small

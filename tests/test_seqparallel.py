@@ -66,6 +66,7 @@ def _runtimes(S, mode="uncompressed", extra=None):
     ("sketch", {"k": 20, "num_rows": 3, "num_cols": 64, "num_blocks": 2}),
     ("true_topk", {"k": 20}),
 ])
+@pytest.mark.slow
 def test_seq_sharded_round_matches_dense(mode, extra):
     rt_dense, rt_seq = _runtimes(S=32, mode=mode, extra=extra)
     ids = jnp.arange(W, dtype=jnp.int32)
@@ -84,6 +85,7 @@ def test_seq_sharded_round_matches_dense(mode, extra):
                                rtol=2e-3, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_seq_shard_boundary_mc_tokens_and_full_length():
     """Edge coverage (VERDICT r2 item 9): mc_token_ids pinned EXACTLY at
     every seq-shard boundary (first/last position of each shard — the MC
@@ -114,6 +116,7 @@ def test_seq_shard_boundary_mc_tokens_and_full_length():
                                rtol=2e-3, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_long_seq_cuts_attention_memory():
     """The point of the seq axis: a long-S round's per-device temp memory
     must be far below the dense round's (the dense S x S score tensor and

@@ -1,0 +1,498 @@
+"""Compression-signal health + HLO collective ledger + teleview analyzer
+(telemetry/signals.py, telemetry/collectives.py, scripts/teleview.py):
+on-device diagnostics against a numpy reference on a tiny model, schema
+round-trips for the two new event types, ledger parsing/launch counting,
+the driver-loop signals wiring, regime guardrails, and the analyzer's
+summarize/diff contract."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.core import FedRuntime
+from commefficient_tpu.core.server import (check_regime_health,
+                                           validate_regimes)
+from commefficient_tpu.telemetry import (RunTelemetry, SIGNAL_KEYS,
+                                         ledger_from_hlo, round_ledger,
+                                         summarize_ledger, validate_event,
+                                         validate_file)
+from commefficient_tpu.telemetry.schema import TELEMETRY_BASENAME
+
+W, B, D_IN, D_OUT = 4, 4, 6, 3
+D = D_IN * D_OUT
+
+
+def loss_fn(params, batch, mask):
+    pred = batch["x"] @ params["w"]
+    m = mask.astype(jnp.float32)
+    denom = jnp.maximum(m.sum(), 1.0)
+    err = ((pred - batch["y"]) ** 2).sum(axis=1)
+    loss = (err * m).sum() / denom
+    return loss, (loss,)
+
+
+def make_runtime(**kw):
+    cfg_kw = dict(mode="sketch", error_type="virtual", local_momentum=0.0,
+                  virtual_momentum=0.9, weight_decay=0.0, num_workers=W,
+                  local_batch_size=B, track_bytes=True, num_clients=8,
+                  num_results_train=2, num_results_val=2,
+                  k=5, num_rows=2, num_cols=32, exact_num_cols=True)
+    cfg_kw.update(kw)
+    params = {"w": jnp.asarray(
+        np.random.RandomState(0).randn(D_IN, D_OUT), jnp.float32)}
+    return FedRuntime(FedConfig(**cfg_kw), params, loss_fn, num_clients=8)
+
+
+def make_batch(seed=1):
+    rng = np.random.RandomState(seed)
+    batch = {"x": jnp.asarray(rng.randn(W, B, D_IN), jnp.float32),
+             "y": jnp.asarray(rng.randn(W, B, D_OUT), jnp.float32)}
+    return batch, jnp.ones((W, B), bool), jnp.arange(W, dtype=jnp.int32)
+
+
+def fetch_signals(metrics):
+    return {k: float(np.asarray(v)) for k, v in metrics["signals"].items()}
+
+
+# ------------------------------------------------------- on-device signals
+
+
+def test_signals_present_and_keys_complete():
+    rt = make_runtime()
+    batch, mask, ids = make_batch()
+    state, metrics = rt.round(rt.init_state(), ids, batch, mask, 0.05)
+    sig = fetch_signals(metrics)
+    assert set(sig) == set(SIGNAL_KEYS)
+
+
+def test_no_signals_flag_drops_them():
+    rt = make_runtime(signals=False)
+    batch, mask, ids = make_batch()
+    state, metrics = rt.round(rt.init_state(), ids, batch, mask, 0.05)
+    assert metrics["signals"] is None
+    assert state.sig_Verror is None
+
+
+def test_no_telemetry_drops_signals_too():
+    """--no_telemetry leaves no consumer for the signals — they must
+    not cost hot-path work (in mesh sketch mode the l2estimates are
+    table-sized all-gathers; --signals_exact adds 2 x O(d) shadow
+    state) for a stream nobody reads."""
+    rt = make_runtime(telemetry=False, signals_exact=True)
+    assert not rt._signals and not rt._signals_shadow
+    batch, mask, ids = make_batch()
+    state, metrics = rt.round(rt.init_state(), ids, batch, mask, 0.05)
+    assert metrics["signals"] is None
+    assert state.sig_Verror is None       # no dead shadow allocation
+
+
+def test_resume_rezeros_missing_shadow(tmp_path):
+    """A checkpoint written WITHOUT the --signals_exact shadow fields
+    must resume with them re-zeroed (not None) when the resuming
+    runtime expects a shadow — otherwise topk_overlap silently goes
+    dead for the whole resumed run."""
+    from commefficient_tpu.cv_train import setup_checkpointing
+    plain = make_runtime(do_resume=True, checkpoint_every=1,
+                         checkpoint_path=str(tmp_path))
+    batch, mask, ids = make_batch()
+    state, _ = plain.round(plain.init_state(), ids, batch, mask, 0.05)
+    mgr, _, _ = setup_checkpointing(plain.cfg, plain, "quad")
+    mgr.save(state, 1)
+    exact = make_runtime(do_resume=True, checkpoint_every=1,
+                         checkpoint_path=str(tmp_path),
+                         signals_exact=True)
+    assert exact._signals_shadow
+    _, start, restored = setup_checkpointing(exact.cfg, exact, "quad")
+    assert start == 1 and restored is not None
+    assert restored.sig_Verror is not None
+    np.testing.assert_array_equal(np.asarray(restored.sig_Verror),
+                                  np.zeros(D, np.float32))
+    # and the resumed state runs through the shadowed round
+    s2, metrics = exact.round(restored, ids, batch, mask, 0.05)
+    assert np.isfinite(fetch_signals(metrics)["topk_overlap"])
+
+
+def test_uncompressed_signals_match_numpy_reference():
+    """First round, momentum 0: the aggregated gradient is the
+    datum-weighted mean of per-client mean gradients of the quadratic
+    loss — computable exactly in numpy — and update = lr * agg."""
+    lr = 0.05
+    rt = make_runtime(mode="uncompressed", error_type="none",
+                      virtual_momentum=0.0)
+    batch, mask, ids = make_batch()
+    w0 = np.asarray(rt.initial_weights).reshape(D_IN, D_OUT)
+    state, metrics = rt.round(rt.init_state(), ids, batch, mask, lr)
+    sig = fetch_signals(metrics)
+
+    x = np.asarray(batch["x"], np.float64)
+    y = np.asarray(batch["y"], np.float64)
+    # per-client mean grad of sum-over-outputs squared error, x n_c,
+    # summed over clients, / total datums (core/client.py weighting)
+    g = np.zeros((D_IN, D_OUT))
+    for c in range(W):
+        res = x[c] @ w0 - y[c]                      # (B, D_OUT)
+        g += (2.0 * x[c].T @ res / B) * B
+    g /= W * B
+    expect = float(np.linalg.norm(g))
+    assert sig["grad_norm"] == pytest.approx(expect, rel=1e-4)
+    assert sig["grad_true_norm"] == pytest.approx(expect, rel=1e-4)
+    assert sig["update_norm"] == pytest.approx(lr * expect, rel=1e-4)
+    assert sig["support_density"] == pytest.approx(1.0)
+    # momentum 0: Vvelocity == agg
+    assert sig["velocity_norm"] == pytest.approx(expect, rel=1e-4)
+    assert np.isnan(sig["grad_l2estimate"])
+    assert np.isnan(sig["topk_overlap"])  # needs --signals_exact
+    # state norms agree with the signal (the fetched state IS the source)
+    assert float(np.linalg.norm(np.asarray(state.Vvelocity))) == \
+        pytest.approx(sig["velocity_norm"], rel=1e-5)
+
+
+def test_sketch_signals_lossless_regime():
+    """c >= d: the sketch round-trip is exact, so the l2estimate matches
+    the true dense norm and the recovered top-k is the exact top-k."""
+    rt = make_runtime(signals_exact=True)          # c=32 >= d=18
+    assert rt._signals_shadow
+    batch, mask, ids = make_batch()
+    state = rt.init_state()
+    assert state.sig_Verror is not None and state.sig_Verror.shape == (D,)
+    for _ in range(3):
+        state, metrics = rt.round(state, ids, batch, mask, 0.05)
+    sig = fetch_signals(metrics)
+    assert sig["grad_l2estimate"] == pytest.approx(sig["grad_true_norm"],
+                                                   rel=1e-4)
+    assert sig["topk_overlap"] == pytest.approx(1.0)
+    assert sig["support_density"] == pytest.approx(rt.cfg.k / D)
+    assert sig["error_norm"] > 0          # EF accumulator is accumulating
+    # the lossless shadow tracks the table state exactly: its error's
+    # norm estimate equals the dense shadow error norm
+    assert float(np.linalg.norm(np.asarray(state.sig_Verror))) == \
+        pytest.approx(sig["error_l2estimate"], rel=1e-3)
+
+
+def test_sketch_compressing_overlap_below_one():
+    """At real compression (c << d) with a few accumulation rounds the
+    recovered support must remain a VALID fraction in [0, 1] — and the
+    collision-noise proxy (l2estimate vs true norm) must diverge from
+    the lossless identity."""
+    rt = make_runtime(signals_exact=True, num_cols=4, num_rows=1, k=3)
+    batch, mask, ids = make_batch()
+    state = rt.init_state()
+    overlaps = []
+    for _ in range(4):
+        state, metrics = rt.round(state, ids, batch, mask, 0.05)
+        sig = fetch_signals(metrics)
+        overlaps.append(sig["topk_overlap"])
+    assert all(0.0 <= o <= 1.0 for o in overlaps)
+    assert sig["grad_l2estimate"] != pytest.approx(sig["grad_true_norm"],
+                                                   rel=1e-6)
+
+
+def test_true_topk_exact_overlap_is_one():
+    rt = make_runtime(mode="true_topk", error_type="virtual",
+                      signals_exact=True)
+    assert not rt._signals_shadow          # dense error needs no shadow
+    batch, mask, ids = make_batch()
+    state = rt.init_state()
+    for _ in range(2):
+        state, metrics = rt.round(state, ids, batch, mask, 0.05)
+    sig = fetch_signals(metrics)
+    assert sig["topk_overlap"] == pytest.approx(1.0)
+    assert state.sig_Verror is None
+
+
+def test_signals_do_not_change_numerics():
+    """The diagnostics are observers: weights after N rounds are
+    bit-identical with signals on, off, and exact."""
+    states = []
+    for kw in ({}, {"signals": False}, {"signals_exact": True}):
+        rt = make_runtime(**kw)
+        batch, mask, ids = make_batch()
+        s = rt.init_state()
+        for _ in range(3):
+            s, _ = rt.round(s, ids, batch, mask, 0.05)
+        states.append(np.asarray(s.ps_weights))
+    np.testing.assert_array_equal(states[0], states[1])
+    np.testing.assert_array_equal(states[0], states[2])
+
+
+# ------------------------------------------------------- schema round-trip
+
+
+def test_signals_and_collectives_events_validate(tmp_path):
+    rt = make_runtime()
+    tel = RunTelemetry(str(tmp_path), "test", cfg=rt.cfg)
+    tel.instrument(rt)
+    batch, mask, ids = make_batch()
+    state, metrics = rt.round(rt.init_state(), ids, batch, mask, 0.05)
+    from commefficient_tpu.telemetry import signals_to_host
+    tel.signals_event(rnd=1, mode=rt.cfg.mode,
+                      signals=signals_to_host(metrics["signals"]),
+                      download_bytes=1.0, upload_bytes=2.0,
+                      client_download_bytes=[1.0] * W,
+                      client_upload_bytes=[0.5] * W)
+    tel.write_summary(aborted=False, n_rounds=1)
+    tel.close()
+    assert validate_file(tel.path) == []
+    events = [json.loads(l) for l in open(tel.path)]
+    kinds = [e["event"] for e in events]
+    # the JitWatcher emits a collectives inventory next to each compile
+    assert "compile" in kinds and "collectives" in kinds
+    coll = [e for e in events if e["event"] == "collectives"][0]
+    assert coll["name"] == "round_step"
+    assert isinstance(coll["counts"], dict)
+    assert coll["n_collectives"] == 0       # single device: no collectives
+    sig = [e for e in events if e["event"] == "signals"][0]
+    assert sig["mode"] == "sketch" and sig["round"] == 1
+    assert len(sig["client_download_bytes"]) == W
+    # NaN signals must have landed as null, never the NaN token
+    raw = open(tel.path).read()
+    assert "NaN" not in raw
+
+
+def test_schema_rejects_malformed_new_events():
+    assert validate_event({"event": "signals", "t": 0.0, "seq": 0})
+    assert validate_event({"event": "collectives", "t": 0.0, "seq": 0})
+    ok = {"event": "collectives", "t": 0.0, "seq": 0, "name": "round_step",
+          "n_collectives": 2, "counts": {"all-reduce": 2},
+          "total_bytes": 128, "ops": []}
+    assert validate_event(ok) == []
+    bad = dict(ok, counts=["all-reduce"])
+    assert validate_event(bad)
+
+
+def test_check_schema_script_selftest(tmp_path):
+    """Satellite: scripts/check_telemetry_schema.py --selftest generates
+    a sample stream containing EVERY event type (the two new ones
+    included) and validates it with the same code CI runs."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                     "check_telemetry_schema.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--selftest"]) == 0
+    from commefficient_tpu.telemetry.schema import EVENT_FIELDS
+    stream = mod.sample_stream()
+    kinds = {json.loads(l)["event"] for l in stream}
+    assert kinds == set(EVENT_FIELDS), "selftest must cover every type"
+    # the flag composes with lint roots (any order) instead of being
+    # misread as a filesystem path
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert mod.main(["--selftest", str(empty)]) == 0
+    assert mod.main([str(empty), "--selftest"]) == 0
+
+
+# ------------------------------------------------------------- driver loop
+
+
+def test_driver_loop_emits_signals_events(tmp_path):
+    from commefficient_tpu import cv_train
+    from test_telemetry import StubDS
+
+    rt = make_runtime(dataset_name="SYNTH", telemetry_every=1)
+    tel = RunTelemetry(str(tmp_path), "cv_train", cfg=rt.cfg)
+    tel.instrument(rt)
+    cfg = rt.cfg.replace(num_epochs=1.0, pivot_epoch=0.5)
+    state, summary = cv_train.train(cfg, rt, rt.init_state(),
+                                    StubDS(), StubDS(), telemetry=tel)
+    tel.close()
+    assert summary is not None
+    assert validate_file(tel.path) == []
+    events = [json.loads(l) for l in open(tel.path)]
+    sigs = [e for e in events if e["event"] == "signals"]
+    rounds = [e for e in events if e["event"] == "round"]
+    assert len(sigs) == len(rounds) >= 1     # same cadence
+    s = sigs[0]
+    assert s["upload_bytes"] == rounds[0]["upload_bytes"]
+    # exact per-client bytes: W participating clients, uniform uploads
+    assert len(s["client_upload_bytes"]) == W
+    assert sum(s["client_upload_bytes"]) == pytest.approx(s["upload_bytes"])
+    assert s["error_norm"] is not None and s["error_norm"] >= 0
+
+
+# ------------------------------------------------------- collective ledger
+
+
+SAMPLE_HLO = """
+HloModule jit_round
+  %x1 = f32[492]{0} all-to-all(f32[492]{0} %p0), replica_groups={}
+  %x2 = f32[492]{0} all-to-all(f32[492]{0} %x1), replica_groups={}
+  %ar = (f32[]{/*index=0*/}, f32[3,64]{1,0}) all-reduce-start(f32[] %a, f32[3,64] %b)
+  %ad = (f32[], f32[3,64]) all-reduce-done((f32[], f32[3,64]) %ar)
+  %rs = bf16[492]{0} reduce-scatter(bf16[3936]{0} %big), dimensions={0}
+  %ag = f32[3936]{0} all-gather(f32[492]{0} %rs2), dimensions={0}
+"""
+
+
+def test_ledger_parses_kinds_sizes_dtypes_and_launches():
+    ledger = ledger_from_hlo(SAMPLE_HLO)
+    s = summarize_ledger(ledger)
+    # -done lines must not double-count; the combined all-reduce tuple is
+    # ONE launch with two payload elements
+    assert s["counts"] == {"all-to-all": 2, "all-reduce": 1,
+                           "reduce-scatter": 1, "all-gather": 1}
+    ar = [e for e in ledger if e["kind"] == "all-reduce"]
+    assert len(ar) == 2
+    assert {e["n_elements"] for e in ar} == {1, 192}
+    assert len({e["combined_in"] for e in ar}) == 1
+    rs = [e for e in ledger if e["kind"] == "reduce-scatter"][0]
+    assert rs["dtype"] == "bf16" and rs["bytes"] == 492 * 2
+    assert s["total_bytes"] == (492 * 4 * 2 + 4 + 192 * 4 + 492 * 2
+                                + 3936 * 4)
+
+
+@pytest.mark.parametrize("mode,extra", [
+    ("sketch", {"error_type": "virtual"}),
+    ("local_topk", {"error_type": "local", "local_momentum": 0.9,
+                    "lr_scale": 0.01}),
+])
+def test_mesh_round_ledger_counts(devices, mode, extra):
+    """The compiled mesh round's ledger must stay within the dryrun's
+    count bounds — the in-tree guard for the 32x unroll class (the same
+    bounds __graft_entry__.dryrun_multichip asserts on all 5 modes)."""
+    from commefficient_tpu.parallel import make_mesh
+    from commefficient_tpu.telemetry.collectives import \
+        ROUND_COLLECTIVE_LAUNCH_BOUNDS as _COLLECTIVE_COUNT_BOUNDS
+    mesh = make_mesh((8,), ("clients",), devices=devices)
+    rt = make_runtime(mode=mode, num_workers=8, **extra)
+    params = {"w": jnp.asarray(
+        np.random.RandomState(0).randn(D_IN, D_OUT), jnp.float32)}
+    rt = FedRuntime(rt.cfg.replace(grad_size=0), params, loss_fn,
+                    num_clients=8, mesh=mesh)
+    rng = np.random.RandomState(1)
+    batch = {"x": jnp.asarray(rng.randn(8, B, D_IN), jnp.float32),
+             "y": jnp.asarray(rng.randn(8, B, D_OUT), jnp.float32)}
+    mask = jnp.ones((8, B), bool)
+    ids = jnp.arange(8, dtype=jnp.int32)
+    state = rt.init_state()
+    ledger = round_ledger(rt, state, ids, batch, mask)
+    assert ledger, "a mesh round must contain collectives"
+    counts = summarize_ledger(ledger)["counts"]
+    for kind, limit in _COLLECTIVE_COUNT_BOUNDS.items():
+        assert counts.get(kind, 0) <= limit, (mode, counts)
+
+
+# --------------------------------------------------------- regime guards
+
+
+def test_regime_guardrails_fire_and_strict_raises(capsys):
+    # measured-divergent: local_topk + local EF at dense-stable lr
+    bad = FedConfig(mode="local_topk", error_type="local", lr_scale=0.1,
+                    local_momentum=0.0)
+    assert check_regime_health(bad)
+    validate_regimes(bad)
+    assert "MEASURED divergent" in capsys.readouterr().err
+    with pytest.raises(ValueError, match="strict_regimes"):
+        validate_regimes(bad.replace(strict_regimes=True))
+    # inside the envelope: no warning
+    ok = bad.replace(lr_scale=0.01)
+    assert check_regime_health(ok) == []
+    # measured-divergent: subtract-EF at high collision load (d/c >= 100)
+    sub = FedConfig(mode="sketch", error_type="virtual", local_momentum=0.0,
+                    sketch_ef="subtract", num_cols=1000, grad_size=200_000)
+    assert check_regime_health(sub)
+    with pytest.raises(ValueError, match="collision load"):
+        validate_regimes(sub.replace(strict_regimes=True))
+    # the stable loads / the dense-state rescue are NOT flagged
+    assert check_regime_health(sub.replace(num_cols=20_000)) == []
+    assert check_regime_health(
+        sub.replace(sketch_server_state="dense")) == []
+
+
+def test_strict_regimes_wired_through_runtime():
+    params = {"w": jnp.zeros((D_IN, D_OUT), jnp.float32)}
+    cfg = FedConfig(mode="local_topk", error_type="local", lr_scale=0.4,
+                    local_momentum=0.0, num_workers=W, local_batch_size=B,
+                    strict_regimes=True)
+    with pytest.raises(ValueError, match="strict_regimes"):
+        FedRuntime(cfg, params, loss_fn, num_clients=8)
+
+
+# ---------------------------------------------------------------- teleview
+
+
+def _write_stream(path, error_norm=1.0, a2a_count=2, loss=2.0):
+    tel = RunTelemetry(str(path), "test", cfg=None)
+    tel.event("collectives", name="round_step", n_collectives=3 + a2a_count,
+              counts={"all-reduce": 3, "all-to-all": a2a_count},
+              total_bytes=4096, ops=[])
+    sig = {k: 1.0 for k in SIGNAL_KEYS}
+    sig["error_norm"] = error_norm
+    tel.signals_event(rnd=1, mode="sketch", signals=sig,
+                      download_bytes=8.0, upload_bytes=8.0,
+                      client_download_bytes=[4.0, 4.0],
+                      client_upload_bytes=[4.0, 4.0])
+    tel.round_event(rnd=1, epoch=1, lr=0.1, loss=loss, acc=0.5, n_valid=8,
+                    download_bytes=8.0, upload_bytes=8.0,
+                    host_s=0.01, dispatch_s=0.01, device_s=0.01)
+    tel.write_summary(aborted=False, n_rounds=1)
+    tel.close()
+    assert validate_file(tel.path) == []
+    return tel.path
+
+
+def _teleview():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "teleview", os.path.join(os.path.dirname(__file__), os.pardir,
+                                 "scripts", "teleview.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_teleview_fallback_constants_match_package():
+    """teleview must run on machines without jax, so it carries literal
+    fallbacks of the two schema constants — pin them to the canonical
+    values so they cannot drift."""
+    import re
+    src = open(os.path.join(os.path.dirname(__file__), os.pardir,
+                            "scripts", "teleview.py")).read()
+    m = re.search(r'TELEMETRY_BASENAME = "([^"]+)"', src)
+    assert m and m.group(1) == TELEMETRY_BASENAME
+    block = re.search(r"SIGNAL_KEYS = \((.*?)\)", src, re.S).group(1)
+    assert tuple(re.findall(r'"([a-z_0-9]+)"', block)) == SIGNAL_KEYS
+
+
+def test_teleview_summarize_and_clean_diff(tmp_path, capsys):
+    tv = _teleview()
+    a = _write_stream(tmp_path / "a")
+    assert tv.main(["summarize", a]) == 0
+    out = capsys.readouterr().out
+    assert "collectives" in out and "signals" in out and "error_norm" in out
+    b = _write_stream(tmp_path / "b")
+    assert tv.main(["diff", a, b]) == 0
+
+
+def test_teleview_diff_fails_on_collective_count_growth(tmp_path, capsys):
+    tv = _teleview()
+    a = _write_stream(tmp_path / "a", a2a_count=2)
+    b = _write_stream(tmp_path / "b", a2a_count=32)   # the r5 unroll class
+    assert tv.main(["diff", a, b]) == 1
+    assert "all-to-all launch count 2 -> 32" in capsys.readouterr().out
+    # slack makes it pass again (opt-in tolerance)
+    assert tv.main(["diff", a, b, "--count_slack", "30",
+                    "--bytes_ratio", "100"]) == 0
+
+
+def test_teleview_diff_fails_on_signal_norm_blowup(tmp_path, capsys):
+    tv = _teleview()
+    a = _write_stream(tmp_path / "a", error_norm=10.0)
+    b = _write_stream(tmp_path / "b", error_norm=100.0)  # EF divergence
+    assert tv.main(["diff", a, b]) == 1
+    assert "error_norm" in capsys.readouterr().out
+    assert tv.main(["diff", a, b, "--signal_ratio", "20"]) == 0
+
+
+def test_teleview_diff_fails_on_loss_regression(tmp_path):
+    tv = _teleview()
+    a = _write_stream(tmp_path / "a", loss=2.0)
+    b = _write_stream(tmp_path / "b", loss=3.0)
+    assert tv.main(["diff", a, b]) == 1
+    assert tv.main(["diff", a, b, "--loss_ratio", "2.0"]) == 0
