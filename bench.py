@@ -94,9 +94,9 @@ def run_cifar(result: dict, W: int = 8, B: int = 64,
     client_ids = jnp.arange(W, dtype=jnp.int32)
     lr = 0.1
 
-    dt, metrics = timed_rounds(runtime, (client_ids, batch, mask, lr),
-                               warmup=2, rounds=n_rounds, desc="cifar",
-                               profiler=profiler)
+    dt, metrics, phases = timed_rounds(runtime, (client_ids, batch, mask, lr),
+                                       warmup=2, rounds=n_rounds, desc="cifar",
+                                       profiler=profiler)
 
     images = n_rounds * W * B
     ips = images / dt
@@ -107,6 +107,9 @@ def run_cifar(result: dict, W: int = 8, B: int = 64,
     result["value"] = round(ips, 1)
     result["vs_baseline"] = round(ips / NOMINAL_SINGLE_GPU_IMG_PER_SEC, 3)
     result["timed_rounds"] = n_rounds
+    # where the timed wall clock went: dispatch (async round calls),
+    # device_wait (trailing completion barrier), host (loop remainder)
+    result["phase_split"] = phases
 
     # MFU numerator = MODEL FLOPs (the ResNet-9 fwd+bwd for the round's
     # W*B images, from XLA's cost analysis of the bare value_and_grad — no
@@ -132,6 +135,16 @@ def run_cifar(result: dict, W: int = 8, B: int = 64,
     log(f"model FLOPs/round {flops:.3e}, peak {peak:.0f}, MFU {mfu:.3f}")
     result["mfu"] = round(mfu, 4) if np.isfinite(mfu) else None
     if telemetry is not None:
+        # schema-validated utilization event in the shared stream: the
+        # same MFU the JSON line carries, plus the starvation fractions
+        from commefficient_tpu.telemetry.utilization import emit_from_totals
+        emit_from_totals(
+            telemetry, rnd=n_rounds, rounds=n_rounds, wall_s=dt,
+            host_s=phases["host_s"], dispatch_s=phases["dispatch_s"],
+            device_s=phases["device_wait_s"],
+            flops_per_round=(flops if np.isfinite(flops) else None),
+            flops_source="cost_analysis",
+            device_kind=getattr(jax.devices()[0], "device_kind", "unknown"))
         telemetry.bench_event(result["metric"], result)
 
 

@@ -16,14 +16,13 @@ from __future__ import annotations
 import sys
 import time
 
-# peak bf16 FLOP/s by TPU generation (public spec sheets)
-PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,        # v5p
-    "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,   # v6e / Trillium
-}
+# peak bf16 FLOP/s by generation — single source of truth in
+# telemetry/utilization.py (the `utilization` events and the benches
+# must agree on the MFU denominator); re-exported under the old name
+from commefficient_tpu.telemetry.utilization import (  # noqa: F401
+    PEAK_FLOPS_BY_KIND as PEAK_FLOPS,
+    peak_flops_for,
+)
 
 
 def log(*a):
@@ -32,11 +31,11 @@ def log(*a):
 
 def peak_flops(device) -> float:
     kind = getattr(device, "device_kind", "")
-    for name, peak in PEAK_FLOPS.items():
-        if kind.startswith(name):
-            return peak
-    log(f"WARNING: unknown device kind {kind!r}; assuming v5e peak")
-    return 197e12
+    peak = peak_flops_for(kind)
+    if peak is None:
+        log(f"WARNING: unknown device kind {kind!r}; assuming v5e peak")
+        return 197e12
+    return peak
 
 
 # substrings (lower-cased) that mark an infra failure worth retrying, as
@@ -110,7 +109,13 @@ def timed_rounds(runtime, round_args, *, warmup, rounds, desc: str,
     round's peak HBM and has been observed to tip the GPT-2 round into
     RESOURCE_EXHAUSTED.
 
-    Returns ``(dt_seconds, last_metrics)`` for ``rounds`` timed rounds.
+    Returns ``(dt_seconds, last_metrics, phases)`` for ``rounds`` timed
+    rounds. ``phases`` splits the wall clock: ``dispatch_s`` (time inside
+    the async round calls), ``device_wait_s`` (the trailing completion
+    barrier) and ``host_s`` (everything else — loop overhead and, when
+    profiling, the per-round syncs; the batch is pre-staged here so
+    there is no data-fetch phase). All clocks are ``perf_counter`` — an
+    NTP step during a long timing loop must not skew the headline.
     """
     import jax
     import jax.numpy as jnp
@@ -124,9 +129,9 @@ def timed_rounds(runtime, round_args, *, warmup, rounds, desc: str,
         return s
 
     log("compiling + warmup...")
-    t0 = time.time()
+    t0 = time.perf_counter()
     state = with_retries(warm, desc=f"{desc} compile+warmup")
-    log(f"warmup done in {time.time() - t0:.1f}s")
+    log(f"warmup done in {time.perf_counter() - t0:.1f}s")
     host_state = jax.tree.map(np.asarray, state)
     jax.tree.map(lambda x: x.delete(), state)
 
@@ -134,12 +139,15 @@ def timed_rounds(runtime, round_args, *, warmup, rounds, desc: str,
         # fresh device buffers per attempt (the round donates its input)
         s = jax.tree.map(jnp.asarray, host_state)
         jax.block_until_ready(s)
-        t0 = time.time()
+        t0 = time.perf_counter()
+        dispatch_s = 0.0
         try:
             for i in range(rounds):
                 if profiler is not None:
                     profiler.maybe_start(i + 1)
+                td = time.perf_counter()
                 s, m = runtime.round(s, *round_args)
+                dispatch_s += time.perf_counter() - td
                 if profiler is not None:
                     profiler.maybe_stop(
                         i + 1, lambda: jax.block_until_ready(s.ps_weights))
@@ -153,7 +161,12 @@ def timed_rounds(runtime, round_args, *, warmup, rounds, desc: str,
             # window STOP beyond the timed round count: keep the partial
             # trace instead of leaking the open profiler
             profiler.finalize(lambda: jax.block_until_ready(s.ps_weights))
+        t1 = time.perf_counter()
         float(s.ps_weights[0])
-        return time.time() - t0, m
+        t2 = time.perf_counter()
+        phases = {"host_s": round(t2 - t0 - dispatch_s - (t2 - t1), 6),
+                  "dispatch_s": round(dispatch_s, 6),
+                  "device_wait_s": round(t2 - t1, 6)}
+        return t2 - t0, m, phases
 
     return with_retries(timed, desc=f"{desc} timing loop")

@@ -37,26 +37,14 @@ import json
 import numpy as np
 
 from bench_common import log, peak_flops, timed_rounds
+# the analytic FLOPs formula moved next to the model so the gpt2_train
+# driver's utilization telemetry shares it (models/gpt2.py)
+from commefficient_tpu.models.gpt2 import gpt2_model_flops  # noqa: F401
 
 # PersonaChat-lineage throughput anchor (NOMINAL, not measured: a V100
 # runs GPT-2-small fwd+bwd at ~4.5k tok/s; the reference publishes no
 # numbers of its own — BASELINE.md)
 NOMINAL_SINGLE_GPU_TOK_PER_SEC = 4500.0
-
-
-def gpt2_model_flops(gcfg, tokens: int, S: int) -> float:
-    """Analytic fwd+bwd model FLOPs for ``tokens`` tokens of GPT-2 at
-    sequence length S (2 FLOPs per MAC; backward = 2x forward):
-
-    - block matmuls: qkv 3E^2 + attn proj E^2 + mlp 8E^2 = 12E^2 MACs
-      per token per layer,
-    - attention scores+values: 2*S*E MACs per token per layer (causal
-      masking not discounted — consistent with common MFU practice),
-    - tied LM head: E*V MACs per token.
-    """
-    E, L, V = gcfg.n_embd, gcfg.n_layer, gcfg.total_vocab
-    fwd_per_tok = 2 * (12 * E * E * L + 2 * S * E * L + E * V)
-    return 3.0 * fwd_per_tok * tokens
 
 
 def run(remat: bool = True, telemetry=None, profiler=None) -> dict:
@@ -113,9 +101,9 @@ def run(remat: bool = True, telemetry=None, profiler=None) -> dict:
     ids = jnp.arange(W, dtype=jnp.int32)
 
     n_rounds = 8
-    dt, metrics = timed_rounds(runtime, (ids, batch, mask, 0.1),
-                               warmup=1, rounds=n_rounds, desc="gpt2",
-                               profiler=profiler)
+    dt, metrics, phases = timed_rounds(runtime, (ids, batch, mask, 0.1),
+                                       warmup=1, rounds=n_rounds, desc="gpt2",
+                                       profiler=profiler)
 
     toks = n_rounds * W * B * NC * S
     tps = toks / dt
@@ -136,8 +124,16 @@ def run(remat: bool = True, telemetry=None, profiler=None) -> dict:
         "mfu": round(mfu, 4) if np.isfinite(mfu) else None,
         "tokens_per_round": W * B * NC * S,
         "timed_rounds": n_rounds,
+        "phase_split": phases,
     }
     if telemetry is not None:
+        from commefficient_tpu.telemetry.utilization import emit_from_totals
+        emit_from_totals(
+            telemetry, rnd=n_rounds, rounds=n_rounds, wall_s=dt,
+            host_s=phases["host_s"], dispatch_s=phases["dispatch_s"],
+            device_s=phases["device_wait_s"],
+            flops_per_round=flops, flops_source="analytic",
+            device_kind=getattr(jax.devices()[0], "device_kind", "unknown"))
         telemetry.bench_event(result["metric"], result)
     return result
 

@@ -269,6 +269,12 @@ class FedConfig:
     # otherwise (~5% overhead worst case instead of several-fold). Set 1
     # explicitly for convergence studies where per-round curves matter.
     telemetry_every: int = -1
+    # peak FLOP/s of one accelerator for MFU accounting
+    # (telemetry/utilization.py): 0 = look the device_kind up in the
+    # built-in per-generation table; set explicitly for chips the table
+    # does not know (or to pin a different MFU denominator, e.g. fp32
+    # peak on CPU smoke runs)
+    peak_flops: float = 0.0
     # compression-signal health diagnostics (telemetry/signals.py):
     # cheap on-device norms (aggregated gradient, EF accumulators,
     # update support, sketch collision proxies) computed inside the
@@ -597,6 +603,11 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                         "(each record syncs the round's metrics to host; "
                         "0 = none, -1 = auto: 1 under --test, 64 "
                         "otherwise)")
+    p.add_argument("--peak_flops", type=float, default=0.0,
+                   help="peak FLOP/s of one accelerator for the MFU "
+                        "accounting in `utilization` telemetry events; "
+                        "0 = per-device_kind table "
+                        "(telemetry/utilization.py)")
     p.add_argument("--no_signals", dest="signals", action="store_false",
                    default=True,
                    help="drop the per-round compression-signal health "

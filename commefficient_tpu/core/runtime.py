@@ -38,6 +38,7 @@ from commefficient_tpu.core.server import (server_update,
 from commefficient_tpu.core.state import FedState
 from commefficient_tpu.ops import ravel_params
 from commefficient_tpu.ops.sketch import make_sketch_impl
+from commefficient_tpu.telemetry import tracing
 from commefficient_tpu.telemetry.signals import round_signals
 from commefficient_tpu.utils.jax_compat import shard_map
 
@@ -838,25 +839,32 @@ class FedRuntime:
             # update is identically 0)
             lr = jnp.pad(lr, (0, self.d_pad - lr.shape[0]),
                          constant_values=1.0)
-        return self._round(state, jnp.asarray(client_ids, jnp.int32), batch,
-                           jnp.asarray(mask), lr, self.cs)
+        # span = the async dispatch (argument staging + jit call return);
+        # device completion lands in the caller's "device_wait" span. A
+        # compile shows up here as a multi-second dispatch — cross-check
+        # with the `compile` event the JitWatcher emits for the same round
+        with tracing.span("round_dispatch"):
+            return self._round(state, jnp.asarray(client_ids, jnp.int32),
+                               batch, jnp.asarray(mask), lr, self.cs)
 
     def val(self, state: FedState, batch, mask):
         """Masked evaluation on the current PS weights; returns
         (results_tuple, n_valid). On a mesh the batch pads up to a
         mesh-divisible item count (padding items are masked out) and
         shards over all devices — see _val_step_sharded."""
-        mask = jnp.asarray(mask)
-        if self.mesh is not None:
-            n = self.mesh.size
-            N = mask.shape[0]
-            Np = -(-N // n) * n
-            if Np != N:
-                batch = jax.tree.map(
-                    lambda t: jnp.pad(
-                        t, [(0, Np - N)] + [(0, 0)] * (t.ndim - 1)), batch)
-                mask = jnp.pad(mask, (0, Np - N))
-        return self._val(state.ps_weights, batch, mask)
+        with tracing.span("val_dispatch"):
+            mask = jnp.asarray(mask)
+            if self.mesh is not None:
+                n = self.mesh.size
+                N = mask.shape[0]
+                Np = -(-N // n) * n
+                if Np != N:
+                    batch = jax.tree.map(
+                        lambda t: jnp.pad(
+                            t, [(0, Np - N)] + [(0, 0)] * (t.ndim - 1)),
+                        batch)
+                    mask = jnp.pad(mask, (0, Np - N))
+            return self._val(state.ps_weights, batch, mask)
 
     def flat_weights(self, state: FedState) -> jax.Array:
         """The true-d flat weight vector (mesh padding sliced off) — the

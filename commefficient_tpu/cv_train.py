@@ -34,7 +34,8 @@ from commefficient_tpu.data import (
 )
 from commefficient_tpu.data.device_store import make_device_store
 from commefficient_tpu.losses import make_cv_loss
-from commefficient_tpu.telemetry import ProfilerWindow
+from commefficient_tpu.telemetry import (ProfilerWindow, UtilizationTracker,
+                                         tracing)
 from commefficient_tpu.telemetry import maybe_create as make_telemetry
 from commefficient_tpu.utils import (
     PiecewiseLinear,
@@ -159,6 +160,15 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
                 zeros = jnp.zeros((runtime.cfg.grad_size,), jnp.float32)
                 restored = restored.replace(sig_Vvelocity=zeros,
                                             sig_Verror=jnp.zeros_like(zeros))
+            elif restored.sig_Verror is not None \
+                    and not runtime._signals_shadow:
+                # the reverse direction: a --signals_exact checkpoint
+                # resumed WITHOUT the flag would otherwise thread the
+                # dead dense shadow pair (2 x d fp32 — ~1 GB at GPT-2
+                # scale) through every round and future checkpoint;
+                # drop it so the state matches this runtime's template
+                restored = restored.replace(sig_Vvelocity=None,
+                                            sig_Verror=None)
             start = int(meta.get("epoch", 0))
             print(f"resumed from epoch {start}")
             return mgr, start, restored
@@ -251,12 +261,25 @@ def make_writer(cfg: FedConfig, logdir: Optional[str] = None):
 def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
           lr_mult: Optional[jax.Array] = None, loggers=(), timer=None,
           ckpt_mgr=None, start_epoch: int = 0, writer=None, schedule=None,
-          telemetry=None):
+          telemetry=None, model_flops_per_round: Optional[float] = None):
     timer = timer or Timer()
     # profiler window over --profile_rounds (telemetry/profiling.py);
     # replaces the window previously hardcoded to rounds 2-4 of this
     # driver only
     prof = ProfilerWindow(cfg.profile_dir, cfg.profile_rounds)
+    # span tracer + MFU/starvation accounting (telemetry/tracing.py,
+    # telemetry/utilization.py): only installed when a telemetry stream
+    # exists — with --no_telemetry the process-global tracer stays the
+    # NullTracer and every span site is a shared no-op context manager
+    tracer = util = None
+    if telemetry is not None:
+        tracer = tracing.install()
+        util = UtilizationTracker(telemetry, peak_flops=cfg.peak_flops,
+                                  watcher=telemetry.watcher())
+        if model_flops_per_round:
+            # analytic MFU numerator (gpt2_train passes one: XLA's cost
+            # analysis under-counts scanned rounds, models/gpt2.py)
+            util.set_flops_per_round(model_flops_per_round)
     # device-resident data path: upload the dataset once, gather + augment
     # each round's batch on device, accumulate metrics on device, and fetch
     # once per epoch — a host<->device transfer costs ~170 ms latency on
@@ -318,12 +341,15 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                 lr = schedule(global_round / spe)
                 lr_arr = (jnp.asarray(lr, jnp.float32) if lr_mult is None
                           else lr * lr_mult)
-                if train_store is not None:
-                    batch = train_store.round_batch(
-                        rnd.idx, jax.random.fold_in(data_key, global_round))
-                else:
-                    batch = train_ds.gather(rnd.idx)
-                    batch = {k: jnp.asarray(v) for k, v in batch.items()}
+                with tracing.span("data_fetch"):
+                    if train_store is not None:
+                        batch = train_store.round_batch(
+                            rnd.idx,
+                            jax.random.fold_in(data_key, global_round))
+                    else:
+                        batch = train_ds.gather(rnd.idx)
+                        batch = {k: jnp.asarray(v)
+                                 for k, v in batch.items()}
                 t_host = time.perf_counter()
                 prof.maybe_start(global_round)
                 state, metrics = runtime.round(
@@ -332,51 +358,74 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                 prof.maybe_stop(global_round,
                                 lambda: jax.block_until_ready(state.ps_weights))
                 every = cfg.telemetry_round_every
-                if (telemetry is not None and every
-                        and global_round % every == 0):
+                record = (telemetry is not None and every
+                          and global_round % every == 0)
+                t_device = t_dispatch
+                if record:
                     # each round record costs ONE host sync of the round's
                     # metrics — the price of round-granularity observability
                     # (see config.telemetry_every); the device-side epoch
                     # accumulation below is unchanged either way
-                    jax.block_until_ready(metrics)
+                    with tracing.span("device_wait"):
+                        jax.block_until_ready(metrics)
                     t_device = time.perf_counter()
-                    res = [np.asarray(r) for r in metrics["results"]]
-                    nv = np.asarray(metrics["n_valid"], np.float64)
-                    tot = max(float(nv.sum()), 1.0)
-                    acc_idx = 1 if len(res) > 1 else 0
-                    down_total = up_total = None
-                    down_clients = up_clients = None
-                    if cfg.track_bytes:
-                        # exact per-client byte costs: the round metrics
-                        # scatter them at client_ids over (num_clients,)
-                        down_all = np.asarray(metrics["download_bytes"])
-                        up_all = np.asarray(metrics["upload_bytes"])
-                        down_total = float(down_all.sum())
-                        up_total = float(up_all.sum())
-                        ids = np.asarray(rnd.client_ids)
-                        down_clients = [float(x) for x in down_all[ids]]
-                        up_clients = [float(x) for x in up_all[ids]]
-                    telemetry.round_event(
-                        rnd=global_round, epoch=epoch + 1, lr=float(lr),
-                        loss=float((res[0] * nv).sum() / tot),
-                        acc=float((res[acc_idx] * nv).sum() / tot),
-                        n_valid=float(nv.sum()),
-                        download_bytes=down_total,
-                        upload_bytes=up_total,
-                        host_s=t_host - t_loop, dispatch_s=t_dispatch - t_host,
-                        device_s=t_device - t_dispatch)
-                    if metrics.get("signals"):
-                        # compression-signal health, same cadence / same
-                        # host sync as the round record (signals.py)
-                        from commefficient_tpu.telemetry import \
-                            signals_to_host
-                        telemetry.signals_event(
-                            rnd=global_round, mode=cfg.mode,
-                            signals=signals_to_host(metrics["signals"]),
+                if util is not None:
+                    # device_s is only measured on synced (record) rounds;
+                    # the tracker treats None as "not measured", not zero
+                    util.observe_round(
+                        host_s=t_host - t_loop,
+                        dispatch_s=t_dispatch - t_host,
+                        device_s=(t_device - t_dispatch) if record
+                        else None)
+                # ---- untimed tail: every phase boundary above is already
+                # captured, so the host fetch + JSONL writes below (and
+                # their flush latency) land in NO measured phase — they
+                # are visible instead as the telemetry_emit span
+                if record:
+                    with tracing.span("telemetry_emit"):
+                        res = [np.asarray(r) for r in metrics["results"]]
+                        nv = np.asarray(metrics["n_valid"], np.float64)
+                        tot = max(float(nv.sum()), 1.0)
+                        acc_idx = 1 if len(res) > 1 else 0
+                        down_total = up_total = None
+                        down_clients = up_clients = None
+                        if cfg.track_bytes:
+                            # exact per-client byte costs: the round metrics
+                            # scatter them at client_ids over (num_clients,)
+                            down_all = np.asarray(metrics["download_bytes"])
+                            up_all = np.asarray(metrics["upload_bytes"])
+                            down_total = float(down_all.sum())
+                            up_total = float(up_all.sum())
+                            ids = np.asarray(rnd.client_ids)
+                            down_clients = [float(x) for x in down_all[ids]]
+                            up_clients = [float(x) for x in up_all[ids]]
+                        telemetry.round_event(
+                            rnd=global_round, epoch=epoch + 1, lr=float(lr),
+                            loss=float((res[0] * nv).sum() / tot),
+                            acc=float((res[acc_idx] * nv).sum() / tot),
+                            n_valid=float(nv.sum()),
                             download_bytes=down_total,
                             upload_bytes=up_total,
-                            client_download_bytes=down_clients,
-                            client_upload_bytes=up_clients)
+                            host_s=t_host - t_loop,
+                            dispatch_s=t_dispatch - t_host,
+                            device_s=t_device - t_dispatch)
+                        if metrics.get("signals"):
+                            # compression-signal health, same cadence / same
+                            # host sync as the round record (signals.py)
+                            from commefficient_tpu.telemetry import \
+                                signals_to_host
+                            telemetry.signals_event(
+                                rnd=global_round, mode=cfg.mode,
+                                signals=signals_to_host(metrics["signals"]),
+                                download_bytes=down_total,
+                                upload_bytes=up_total,
+                                client_download_bytes=down_clients,
+                                client_upload_bytes=up_clients)
+                        # MFU/starvation over the window since the last
+                        # record, and the window's spans — the tail of
+                        # this round's trace lands in the next drain
+                        util.emit(global_round)
+                    telemetry.span_event(tracer)
                 rounds_run += 1
                 if telemetry is not None and rounds_run == 1:
                     # device memory after the first round: weights + server
@@ -397,6 +446,10 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                 if cfg.do_test:
                     break
 
+            if util is not None:
+                # close the round window at the epoch boundary: the
+                # validation sweep below must not dilute the round MFU
+                util.emit(global_round)
             sums = (np.asarray(ep_sums) if ep_sums is not None
                     else np.zeros(5))
             train_time = timer()
@@ -418,6 +471,7 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                     # console line above
                     telemetry.nan_abort(nan_round=nan_round, reason=which,
                                         cfg=runtime.cfg)
+                    telemetry.span_event(tracer)  # keep the partial trace
                     telemetry.write_summary(
                         aborted=True, n_rounds=rounds_run,
                         total_download_mib=total_download_mb,
@@ -432,8 +486,9 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
             total_download_mb += download_mb
             total_upload_mb += upload_mb
 
-            test_loss, test_acc = run_validation(runtime, state, val_ds, cfg,
-                                                 val_store=val_store)
+            with tracing.span("validation"):
+                test_loss, test_acc = run_validation(
+                    runtime, state, val_ds, cfg, val_store=val_store)
             test_time = timer()
 
             summary = {
@@ -453,6 +508,7 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
             if telemetry is not None:
                 telemetry.epoch_event(summary, test_time=test_time)
                 telemetry.memory_event(f"epoch_{epoch + 1}")
+                telemetry.span_event(tracer)  # incl. the validation span
             if writer is not None:
                 # reference scalar set (cv_train.py:150-158)
                 writer.add_scalar("Loss/train", train_loss, epoch)
@@ -476,6 +532,12 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
         # mirrors bench_common.timed_rounds' guard
         prof.abort()
         raise
+    finally:
+        # release the process-global span tracer however the loop ends
+        # (the tail below only DRAINS the local tracer object, which
+        # stays valid after uninstall)
+        if tracer is not None:
+            tracing.uninstall()
     # a window whose STOP lies beyond the last round (or that a --test /
     # fractional-epoch break cut short) still yields its partial trace
     prof.finalize(lambda: jax.block_until_ready(state.ps_weights))
@@ -485,6 +547,7 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
     print(f"Avg Download Per Client: {total_download_mb / n_clients:0.2f}")
     print(f"Avg Upload Per Client: {total_upload_mb / n_clients:0.2f}")
     if telemetry is not None:
+        telemetry.span_event(tracer)  # any spans since the last epoch
         telemetry.write_summary(aborted=False, n_rounds=rounds_run,
                                 total_download_mib=total_download_mb,
                                 total_upload_mib=total_upload_mb,

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from commefficient_tpu.telemetry import tracing
+
 
 def _arrays_nbytes(arrays) -> int:
     return sum(int(np.prod(a.shape)) * a.dtype.itemsize
@@ -152,8 +154,12 @@ class DeviceStore:
 
     def round_batch(self, flat_idx, rng) -> Dict[str, jax.Array]:
         """Device batch for the given (host or device) index array; all
-        compute and memory traffic stays on device."""
-        return self._batch(jnp.asarray(flat_idx, jnp.int32), rng)
+        compute and memory traffic stays on device. The span covers the
+        index upload + the async gather/augment dispatch — a long
+        data_gather span against a short round means the batch jit (not
+        the round) owns the input-wait fraction."""
+        with tracing.span("data_gather"):
+            return self._batch(jnp.asarray(flat_idx, jnp.int32), rng)
 
 
 _AUGMENT_FOR = {

@@ -29,6 +29,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from commefficient_tpu.telemetry import tracing
+
 
 class FedDataset:
     # number of natural clients this dataset always produces, or None when
@@ -263,7 +265,14 @@ class FedDataset:
     def gather(self, flat_idx: np.ndarray) -> Dict[str, np.ndarray]:
         """Fancy-index every array; under iid the flat index is routed
         through the global permutation first (reference fed_dataset.py:64-68).
-        Accepts any index shape; output leaves have that leading shape."""
+        Accepts any index shape; output leaves have that leading shape.
+        The host_gather span is the host data pipeline's wall time — on
+        runs without a DeviceStore this IS the input-wait phase the
+        utilization events report."""
+        with tracing.span("host_gather"):
+            return self._gather(flat_idx)
+
+    def _gather(self, flat_idx: np.ndarray) -> Dict[str, np.ndarray]:
         idx = np.asarray(flat_idx)
         if self.train and self.do_iid:
             idx = self.iid_shuffle[idx]

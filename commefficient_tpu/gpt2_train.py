@@ -35,6 +35,7 @@ from commefficient_tpu.losses import make_gpt2_train_loss, make_gpt2_val_loss
 from commefficient_tpu.models.gpt2 import (
     GPT2Config,
     GPT2DoubleHeads,
+    gpt2_model_flops,
     load_hf_weights,
     resolve_attn,
 )
@@ -236,6 +237,12 @@ def main(argv=None):
     if telemetry is not None:
         telemetry.instrument(runtime)
         telemetry.memory_event("init")
+    # analytic MFU numerator for the utilization telemetry: the scanned
+    # round makes XLA's cost analysis under-count ~10x (models/gpt2.py
+    # gpt2_model_flops); tokens/round = W x B x candidates x seq
+    round_tokens = (cfg.num_workers * runtime.batch_size
+                    * cfg.num_candidates * max_seq_len)
+    round_flops = gpt2_model_flops(gcfg, round_tokens, max_seq_len)
     tsv = TSVLogger()
     try:
         state, summary = shared_train(cfg, runtime, state, train_ds, val_ds,
@@ -244,7 +251,8 @@ def main(argv=None):
                                       start_epoch=start_epoch,
                                       schedule=make_gpt2_schedule(cfg),
                                       writer=make_writer(cfg, logdir=logdir),
-                                      telemetry=telemetry)
+                                      telemetry=telemetry,
+                                      model_flops_per_round=round_flops)
     finally:
         if telemetry is not None:
             telemetry.close()

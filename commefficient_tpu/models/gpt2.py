@@ -69,6 +69,27 @@ class GPT2Config:
         return cls(**base)
 
 
+def gpt2_model_flops(gcfg: "GPT2Config", tokens: int, S: int) -> float:
+    """Analytic fwd+bwd model FLOPs for ``tokens`` tokens of this config
+    at sequence length S (2 FLOPs per MAC; backward = 2x forward):
+
+    - block matmuls: qkv 3E^2 + attn proj E^2 + mlp 8E^2 = 12E^2 MACs
+      per token per layer,
+    - attention scores+values: 2*S*E MACs per token per layer (causal
+      masking not discounted — consistent with common MFU practice),
+    - tied LM head: E*V MACs per token.
+
+    This is the MFU numerator for the scanned GPT-2 round: XLA's
+    ``cost_analysis`` counts each ``lax.scan`` body once (no trip-count
+    multiply), under-reporting the microbatch/layer-scanned round ~10x —
+    so both ``bench_gpt2.py`` and the ``gpt2_train`` driver feed this
+    closed form to ``telemetry/utilization.py`` instead.
+    """
+    E, L, V = gcfg.n_embd, gcfg.n_layer, gcfg.total_vocab
+    fwd_per_tok = 2 * (12 * E * E * L + 2 * S * E * L + E * V)
+    return 3.0 * fwd_per_tok * tokens
+
+
 def dense_causal_attention(q, k, v, dropout_rng=None):
     """Plain causal attention: q,k,v (..., S, H, D) -> (..., S, H, D).
     fp32 softmax accumulation regardless of input dtype."""

@@ -1,6 +1,7 @@
 """Run-telemetry subsystem: structured per-round metrics, compile and
 memory observability, compression-signal health (signals.py), the HLO
-collective ledger (collectives.py) and profiler window management —
+collective ledger (collectives.py), wall-time span tracing (tracing.py),
+MFU/starvation accounting (utilization.py) and profiler window management —
 shared by ``cv_train.py``, ``gpt2_train.py``, ``bench.py`` and
 ``bench_gpt2.py``. See schema.py for the JSONL event schema and
 README.md ("Telemetry & profiling") for the consumer-facing contract;
@@ -21,6 +22,12 @@ from commefficient_tpu.telemetry.schema import (SCHEMA_VERSION,
                                                 validate_lines)
 from commefficient_tpu.telemetry.signals import (SIGNAL_KEYS, round_signals,
                                                  signals_to_host)
+from commefficient_tpu.telemetry.tracing import (NullTracer, SpanTracer,
+                                                 span)
+from commefficient_tpu.telemetry.utilization import (PEAK_FLOPS_BY_KIND,
+                                                     UtilizationTracker,
+                                                     emit_from_totals,
+                                                     peak_flops_for)
 
 __all__ = [
     "JitWatcher",
@@ -40,4 +47,11 @@ __all__ = [
     "ledger_from_compiled",
     "round_ledger",
     "summarize_ledger",
+    "NullTracer",
+    "SpanTracer",
+    "span",
+    "PEAK_FLOPS_BY_KIND",
+    "UtilizationTracker",
+    "emit_from_totals",
+    "peak_flops_for",
 ]

@@ -50,6 +50,11 @@ class JitWatcher:
     def __init__(self, telemetry):
         self._telemetry = telemetry
         self.n_compiles = 0
+        # latest cost-analysis FLOPs per watched name (None when XLA
+        # returned no count) — the MFU numerator utilization.py joins
+        # with the round's wall time; a recompile overwrites, so the
+        # count always describes the executable that is actually running
+        self.flops: Dict[str, Any] = {}
 
     def wrap(self, name: str, fn: Callable) -> Callable:
         cache: Dict[Any, Any] = {}
@@ -57,6 +62,8 @@ class JitWatcher:
 
         def emit(n, lower_s, compile_s, cost, fallback=False):
             self.n_compiles += 1
+            if cost.get("flops"):
+                self.flops[name] = cost.get("flops")
             self._telemetry.event(
                 "compile", name=name, n_compiles=n,
                 lower_s=round(lower_s, 6), compile_s=round(compile_s, 6),

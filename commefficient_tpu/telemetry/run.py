@@ -72,7 +72,10 @@ class RunTelemetry:
         self.run_type = run_type
         self.path = os.path.join(logdir, TELEMETRY_BASENAME)
         self._seq = 0
-        self._t0 = time.time()
+        # durations come off the monotonic clock: an NTP step during the
+        # run must not produce a negative/skewed wall_time_s. time.time()
+        # stays only for the absolute `t` envelope field.
+        self._t0 = time.perf_counter()
         self._file = None
         self._counts: Dict[str, int] = {}
         self._watcher: Optional[JitWatcher] = None
@@ -263,6 +266,20 @@ class RunTelemetry:
                    client_download_bytes=client_download_bytes,
                    client_upload_bytes=client_upload_bytes)
 
+    def span_event(self, tracer) -> None:
+        """Drain a tracing.SpanTracer's completed spans into one batched
+        ``span`` event. Call OUTSIDE the timed region (the drivers do it
+        next to the round record) — the JSONL flush must not land inside
+        any phase the spans measure. No-op when nothing happened.
+        n_dropped is per-WINDOW (pop_dropped resets the counter), so
+        summing it across span events gives the true drop total."""
+        dropped = tracer.pop_dropped()
+        spans = tracer.drain()
+        if not spans and not dropped:
+            return
+        self.event("span", t0_wall=tracer.t0_wall,
+                   n_dropped=int(dropped), spans=spans)
+
     def collectives_event(self, name: str, ledger) -> None:
         """Collective inventory of one compiled executable — emitted by
         the JitWatcher next to each `compile` event, so a count
@@ -279,7 +296,7 @@ class RunTelemetry:
                    n_rounds=int(n_rounds),
                    total_download_mib=total_download_mib,
                    total_upload_mib=total_upload_mib,
-                   wall_time_s=round(time.time() - self._t0, 3),
+                   wall_time_s=round(time.perf_counter() - self._t0, 3),
                    event_counts=dict(self._counts),
                    final=final)
 

@@ -20,7 +20,12 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterable, List, Tuple
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+# streams written by older code stay readable: v1 lacks the span /
+# utilization event types (added in v2) but is otherwise identical, so
+# the validator accepts any supported manifest version — a version it
+# does not know is the error, not a version merely older than current
+SUPPORTED_SCHEMA_VERSIONS = (1, SCHEMA_VERSION)
 TELEMETRY_BASENAME = "telemetry.jsonl"
 
 
@@ -42,6 +47,10 @@ def _str(v: Any) -> bool:
 
 def _bool(v: Any) -> bool:
     return isinstance(v, bool)
+
+
+def _opt_str(v: Any) -> bool:
+    return v is None or isinstance(v, str)
 
 
 def _dict(v: Any) -> bool:
@@ -175,6 +184,41 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "total_bytes": _num,
         "ops": _list,                   # [{kind, n_elements, dtype, bytes,
     },                                  #   combined_in}, ...]
+    # batched wall-time spans (telemetry/tracing.py): the tracer's
+    # completed-span buffer, drained at the round-record cadence OUTSIDE
+    # the timed region. Each span: {name, ts (seconds since t0 on the
+    # monotonic clock), dur_s, tid, depth}. t0_wall anchors the
+    # monotonic epoch to unix time; teleview's `timeline` subcommand
+    # renders the stream into a perfetto/chrome-tracing trace.json
+    "span": {
+        "t0_wall": _num,
+        "n_dropped": _int,            # spans lost to the buffer cap in
+                                      # THIS window (per-event counts sum
+                                      # to the run total)
+        "spans": _list,
+    },
+    # step-time attribution + MFU (telemetry/utilization.py): per-round
+    # device time joined with the compiled round's cost-analysis FLOPs
+    # and the per-device_kind peak table (--peak_flops overrides).
+    # flops_per_round/mfu are null when no FLOPs count or no peak is
+    # known — never a fake zero; the three *_frac fields are fractions
+    # of wall_s and need not sum to 1 (device waits are only measured
+    # on rounds that synced)
+    "utilization": {
+        "round": _int,
+        "rounds": _int,               # rounds in this window
+        "wall_s": _num,
+        "device_kind": _str,
+        "peak_flops": _opt_num,
+        "flops_per_round": _opt_num,
+        "flops_source": _opt_str,     # cost_analysis | analytic | null
+        "achieved_flops": _opt_num,   # FLOP/s over the window
+        "mfu": _opt_num,
+        "input_wait_frac": _opt_num,  # host batch assembly (starvation)
+        "dispatch_frac": _opt_num,
+        "device_wait_frac": _opt_num,
+        "straggler_spread": _opt_num,  # (max-min)/mean per-host device_s
+    },
     # end-of-run footer
     "summary": {
         "run_type": _str,
@@ -221,7 +265,7 @@ def validate_event(obj: Any) -> List[str]:
 def validate_lines(lines: Iterable[str]) -> List[Tuple[int, str]]:
     """Validate an iterable of JSONL lines. Returns [(lineno, problem)];
     also checks the stream shape: seq must be 0,1,2,..., the first event
-    must be a manifest with the current SCHEMA_VERSION."""
+    must be a manifest with a SUPPORTED schema version."""
     problems: List[Tuple[int, str]] = []
     expected_seq = 0
     for lineno, line in enumerate(lines, start=1):
@@ -239,10 +283,10 @@ def validate_lines(lines: Iterable[str]) -> List[Tuple[int, str]]:
             if expected_seq == 0 and obj.get("event") != "manifest":
                 problems.append((lineno, "first event must be a manifest"))
             if (obj.get("event") == "manifest"
-                    and obj.get("schema") != SCHEMA_VERSION):
+                    and obj.get("schema") not in SUPPORTED_SCHEMA_VERSIONS):
                 problems.append(
-                    (lineno, f"manifest schema {obj.get('schema')!r} != "
-                             f"supported {SCHEMA_VERSION}"))
+                    (lineno, f"manifest schema {obj.get('schema')!r} not in "
+                             f"supported {SUPPORTED_SCHEMA_VERSIONS}"))
             if obj.get("seq") != expected_seq:
                 problems.append(
                     (lineno, f"seq {obj.get('seq')!r} != expected "

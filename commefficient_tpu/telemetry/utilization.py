@@ -1,0 +1,226 @@
+"""Utilization accounting: achieved FLOP/s, MFU, and starvation fractions.
+
+Joins the three measurements the run already produces but never
+combined: per-round wall-time phases (host batch assembly / async
+dispatch / ``block_until_ready`` device wait), the compiled round's
+cost-analysis FLOPs (``compilewatch.JitWatcher`` records them per
+watched executable), and a per-``device_kind`` peak-FLOPs table
+(overridable with ``--peak_flops``) — and emits schema-validated
+``utilization`` events so "is the chip busy, and if not, who is
+starving it" is a stream field instead of a profiler session.
+
+Conventions
+-----------
+- **MFU** is model/executable FLOPs per wall-clock second over the
+  chip's peak: ``flops_per_round * rounds / (wall_s * peak)``. The wall
+  clock is the full window (including host time) — input starvation
+  LOWERS MFU, by design; ``input_wait_frac`` says how much.
+- ``flops_source`` records where the numerator came from:
+  ``cost_analysis`` (XLA's count for the compiled round — trustworthy
+  for un-scanned rounds, an under-count for scanned ones, see
+  bench_gpt2.py) or ``analytic`` (caller-provided closed form). A null
+  ``flops_per_round`` yields null ``mfu``, never a fake zero.
+- ``input_wait_frac`` / ``dispatch_frac`` / ``device_wait_frac`` are
+  fractions of the window's wall time. Device waits are only measured
+  on rounds that synced (the telemetry record cadence), so the three
+  fractions need not sum to 1 — the remainder is untimed loop tail.
+- ``straggler_spread`` is ``(max - min) / mean`` of per-host device
+  times on a multi-host mesh; null when only one host reported.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# peak bf16 FLOP/s by accelerator generation (public spec sheets),
+# matched by device_kind PREFIX. The single source of truth —
+# bench_common.peak_flops reads this table.
+PEAK_FLOPS_BY_KIND = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "TPU v3": 123e12,
+    "TPU v2": 45e12,
+}
+
+
+def peak_flops_for(device_kind: str,
+                   override: float = 0.0) -> Optional[float]:
+    """Peak FLOP/s for a device kind: the ``--peak_flops`` override when
+    given, else the table (prefix match), else None — an unknown chip
+    yields null MFU rather than a number computed against a guess."""
+    if override:
+        return float(override)
+    for name, peak in PEAK_FLOPS_BY_KIND.items():
+        if device_kind.startswith(name):
+            return peak
+    return None
+
+
+def _frac(part: float, whole: float) -> Optional[float]:
+    return round(part / whole, 6) if whole > 0 else None
+
+
+def straggler_spread(per_host_device_s: List[float]) -> Optional[float]:
+    """(max - min) / mean of per-host device times — 0 on a perfectly
+    balanced mesh, grows with the slowest host's lag. None below two
+    hosts (a single host cannot straggle against itself)."""
+    ts = [float(t) for t in per_host_device_s if t is not None]
+    if len(ts) < 2:
+        return None
+    mean = sum(ts) / len(ts)
+    if mean <= 0:
+        return None
+    return round((max(ts) - min(ts)) / mean, 6)
+
+
+def utilization_fields(*, rounds: int, wall_s: float,
+                       host_s: float, dispatch_s: float, device_s: float,
+                       flops_per_round: Optional[float],
+                       flops_source: Optional[str],
+                       device_kind: str,
+                       peak_flops: Optional[float],
+                       spread: Optional[float] = None) -> Dict[str, Any]:
+    """The pure MFU/starvation math, separated from event emission so
+    tests can drive it with synthetic cost dicts and fake peak tables."""
+    achieved = mfu = None
+    if flops_per_round and wall_s > 0:
+        achieved = flops_per_round * rounds / wall_s
+        if peak_flops:
+            mfu = achieved / peak_flops
+    return {
+        "rounds": int(rounds),
+        "wall_s": round(wall_s, 6),
+        "device_kind": device_kind,
+        "peak_flops": peak_flops,
+        "flops_per_round": flops_per_round,
+        "flops_source": flops_source if flops_per_round else None,
+        "achieved_flops": achieved,
+        # significant figures, not decimal places: a smoke-model mfu of
+        # 2e-8 must not round to a (dishonest) 0.0
+        "mfu": (float(f"{mfu:.6g}") if mfu is not None else None),
+        "input_wait_frac": _frac(host_s, wall_s),
+        "dispatch_frac": _frac(dispatch_s, wall_s),
+        "device_wait_frac": _frac(device_s, wall_s),
+        "straggler_spread": spread,
+    }
+
+
+def emit_from_totals(telemetry, *, rnd: int, rounds: int, wall_s: float,
+                     host_s: float = 0.0, dispatch_s: float = 0.0,
+                     device_s: float = 0.0,
+                     flops_per_round: Optional[float] = None,
+                     flops_source: Optional[str] = None,
+                     device_kind: str = "unknown",
+                     peak_flops: float = 0.0,
+                     per_host_device_s: Optional[List[float]] = None
+                     ) -> Dict[str, Any]:
+    """One-shot ``utilization`` event from aggregate totals (the bench
+    path: one event per timed stage). Returns the computed fields so the
+    caller can fold them into its JSON artifact too."""
+    fields = utilization_fields(
+        rounds=rounds, wall_s=wall_s, host_s=host_s, dispatch_s=dispatch_s,
+        device_s=device_s, flops_per_round=flops_per_round,
+        flops_source=flops_source, device_kind=device_kind,
+        peak_flops=peak_flops_for(device_kind, peak_flops),
+        spread=straggler_spread(per_host_device_s or []))
+    if telemetry is not None:
+        telemetry.event("utilization", round=int(rnd), **fields)
+    return fields
+
+
+class UtilizationTracker:
+    """Windowed utilization accounting for a driver's round loop.
+
+    ``observe_round`` is called every round with the measured phase
+    times (``device_s=None`` on rounds that did not sync); ``emit`` —
+    called at the telemetry record cadence, outside the timed region —
+    joins the window's phase sums with the watched round executable's
+    cost-analysis FLOPs and writes one ``utilization`` event, then
+    resets the window. The window wall clock runs from the first
+    observed round (monotonic ``perf_counter``), so untimed loop tail
+    (telemetry emission itself) is included in the denominator — MFU is
+    honest about everything the loop spends.
+    """
+
+    def __init__(self, telemetry, *, device_kind: Optional[str] = None,
+                 peak_flops: float = 0.0, watcher=None,
+                 watch_name: str = "round_step"):
+        self._telemetry = telemetry
+        self._watcher = watcher
+        self._watch_name = watch_name
+        if device_kind is None:
+            import jax
+            devices = jax.devices()
+            device_kind = (getattr(devices[0], "device_kind", "unknown")
+                           if devices else "none")
+        self.device_kind = device_kind
+        self.peak_flops = peak_flops_for(device_kind, peak_flops)
+        if self.peak_flops is None:
+            print(f"WARNING: no peak-FLOPs entry for device kind "
+                  f"{device_kind!r}; utilization events will carry null "
+                  "mfu (set --peak_flops to override)", file=sys.stderr)
+        self._flops: Optional[float] = None
+        self._flops_source: Optional[str] = None
+        self._reset()
+
+    def _reset(self) -> None:
+        self._win_t0: Optional[float] = None
+        self._rounds = 0
+        self._host_s = self._dispatch_s = self._device_s = 0.0
+        self._per_host: List[float] = []
+
+    def set_flops_per_round(self, flops: Optional[float],
+                            source: str = "analytic") -> None:
+        """Pin the MFU numerator (e.g. an analytic count where XLA's
+        cost analysis under-reports scanned rounds)."""
+        self._flops = flops
+        self._flops_source = source if flops else None
+
+    def _flops_per_round(self) -> Tuple[Optional[float], Optional[str]]:
+        if self._flops is not None:
+            return self._flops, self._flops_source
+        if self._watcher is not None:
+            flops = getattr(self._watcher, "flops", {}).get(self._watch_name)
+            if flops:
+                return float(flops), "cost_analysis"
+        return None, None
+
+    def observe_round(self, *, host_s: float, dispatch_s: float,
+                      device_s: Optional[float] = None) -> None:
+        if self._win_t0 is None:
+            # anchor at the observed round's start, not at emit time
+            self._win_t0 = time.perf_counter() - (
+                host_s + dispatch_s + (device_s or 0.0))
+        self._rounds += 1
+        self._host_s += host_s
+        self._dispatch_s += dispatch_s
+        if device_s is not None:
+            self._device_s += device_s
+
+    def observe_host_device_times(self, per_host_device_s: List[float]
+                                  ) -> None:
+        """Per-host device times for one round on a multi-host mesh
+        (multihost runners feed this; single-host runs never call it)."""
+        self._per_host = list(per_host_device_s)
+
+    def emit(self, rnd: int) -> Optional[Dict[str, Any]]:
+        """Emit one ``utilization`` event over the window since the last
+        emit; no-op (returns None) on an empty window."""
+        if self._rounds == 0 or self._telemetry is None:
+            return None
+        wall = time.perf_counter() - self._win_t0
+        flops, source = self._flops_per_round()
+        fields = utilization_fields(
+            rounds=self._rounds, wall_s=wall, host_s=self._host_s,
+            dispatch_s=self._dispatch_s, device_s=self._device_s,
+            flops_per_round=flops, flops_source=source,
+            device_kind=self.device_kind, peak_flops=self.peak_flops,
+            spread=straggler_spread(self._per_host))
+        self._telemetry.event("utilization", round=int(rnd), **fields)
+        self._reset()
+        return fields

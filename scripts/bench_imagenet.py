@@ -59,8 +59,9 @@ def main():
 
     n_rounds = 10
     t0 = time.time()
-    dt, metrics = timed_rounds(runtime, (ids, batch, mask, 0.1),
-                               warmup=2, rounds=n_rounds, desc="imagenet")
+    dt, metrics, _phases = timed_rounds(runtime, (ids, batch, mask, 0.1),
+                                        warmup=2, rounds=n_rounds,
+                                        desc="imagenet")
     imgs = n_rounds * W * B
     ips = imgs / dt
     loss = float(np.asarray(metrics["results"][0]).mean())

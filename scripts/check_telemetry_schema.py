@@ -12,9 +12,9 @@ Usage:
     python scripts/check_telemetry_schema.py --selftest
 
 ``--selftest`` generates a sample stream containing one event of EVERY
-schema type (signals and collectives included) and validates it — the
-cheap CI proof that the generator vocabulary and the validator
-vocabulary have not drifted apart.
+schema type (signals, collectives, span and utilization included) and
+validates it — the cheap CI proof that the generator vocabulary and the
+validator vocabulary have not drifted apart.
 
 Exit status: 0 when every stream found is valid (or none exist),
 1 when any stream has problems, 2 on usage errors.
@@ -44,6 +44,11 @@ _SAMPLE_OVERRIDES = {
     "counts": {"all-reduce": 1},
     "client_download_bytes": [4.0],
     "client_upload_bytes": [4.0],
+    "spans": [{"name": "data_fetch", "ts": 0.0, "dur_s": 0.01,
+               "tid": 0, "depth": 0},
+              {"name": "round_dispatch", "ts": 0.01, "dur_s": 0.02,
+               "tid": 0, "depth": 1}],
+    "flops_source": "cost_analysis",
 }
 
 

@@ -49,8 +49,8 @@ def measure(W: int, B: int, n_rounds: int = 10):
              "target": jnp.asarray(rng.randint(0, 10, (W, B)), jnp.int32)}
     args = (jnp.arange(W, dtype=jnp.int32), batch, jnp.ones((W, B), bool),
             0.1)
-    dt, _ = timed_rounds(runtime, args, warmup=2, rounds=n_rounds,
-                         desc=f"W{W}xB{B}")
+    dt, _, _ = timed_rounds(runtime, args, warmup=2, rounds=n_rounds,
+                            desc=f"W{W}xB{B}")
     ips = n_rounds * W * B / dt
     peak = peak_flops(jax.devices()[0])
     return ips, peak, runtime, params, loss_fn, batch

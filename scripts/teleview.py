@@ -3,16 +3,24 @@
 
 BENCH/MULTICHIP comparisons have been manual JSON spelunking — ``jq``
 one-liners against artifacts whose schema only the writers knew. This
-CLI reads one stream (``summarize``) or two (``diff``) and turns them
-into the three tables that actually answer "did this run regress":
+CLI reads one stream (``summarize``), two (``diff``), or renders one
+into a timeline (``timeline``):
 
     python scripts/teleview.py summarize runs/x/telemetry.jsonl
     python scripts/teleview.py diff old/telemetry.jsonl new/telemetry.jsonl
+    python scripts/teleview.py timeline runs/x/telemetry.jsonl -o trace.json
 
 ``summarize`` prints the manifest header, compile/collective inventory
 (per watched executable: launch counts by kind, payload bytes), a
 sampled round table, per-signal trends (first/last/min/max of every
-signals.py key) and the epoch table.
+signals.py key), the MFU/starvation line from the ``utilization``
+events, and the epoch table.
+
+``timeline`` renders the ``span`` event stream (telemetry/tracing.py)
+into a perfetto / chrome-tracing ``trace.json`` — complete ("X") slice
+events per span, plus counter ("C") tracks for MFU, input-wait fraction
+and round loss. Open it at https://ui.perfetto.dev or
+chrome://tracing.
 
 ``diff`` compares two runs and EXITS NONZERO on regression:
 - any collective launch-count increase for a watched executable (the
@@ -22,7 +30,10 @@ signals.py key) and the epoch table.
   ``--signal_ratio``x (sketch-EF divergence shows here rounds before
   the loss goes non-finite), or topk_overlap dropping by more than
   ``--overlap_drop``;
-- the final round/epoch loss growing beyond ``--loss_ratio``x.
+- the final round/epoch loss growing beyond ``--loss_ratio``x;
+- MFU dropping more than ``--mfu_drop`` (relative) or the input-wait
+  starvation fraction rising more than ``--starvation_rise``
+  (absolute), from the last ``utilization`` event of each run.
 
 Dependency-free (json + argparse), validates nothing itself — run
 ``scripts/check_telemetry_schema.py`` for schema enforcement.
@@ -144,6 +155,28 @@ def summarize(events: List[Dict[str, Any]], label: str = "") -> None:
                   + f" host {e['host_s']*1e3:.0f}ms dev "
                     f"{e['device_s']*1e3:.0f}ms")
 
+    utils = by_kind(events, "utilization")
+    if utils:
+        u = utils[-1]
+        mfu = _fin(u.get("mfu"))
+        ach = _fin(u.get("achieved_flops"))
+        peak = _fin(u.get("peak_flops"))
+        wait = _fin(u.get("input_wait_frac"))
+        spread = _fin(u.get("straggler_spread"))
+        line = (f"-- utilization ({len(utils)} windows, last: "
+                f"{u.get('rounds', '?')} rounds on "
+                f"{u.get('device_kind', '?')}): ")
+        line += f"mfu {mfu:.3g}" if mfu is not None else "mfu n/a"
+        if ach is not None:
+            line += f", {ach / 1e12:.2f} TFLOP/s"
+            if peak:
+                line += f" of {peak / 1e12:.0f} peak"
+        if wait is not None:
+            line += f", input wait {wait * 100:.1f}%"
+        if spread is not None:
+            line += f", straggler spread {spread:.3f}"
+        print(line)
+
     sigs = by_kind(events, "signals")
     if sigs:
         print(f"-- signals: {len(sigs)} records")
@@ -177,6 +210,75 @@ def summarize(events: List[Dict[str, Any]], label: str = "") -> None:
               f"{summ['n_rounds']} rounds, {summ['wall_time_s']:.1f}s wall")
     for e in by_kind(events, "nan_abort"):
         print(f"   nan_abort at round {e['nan_round']}: {e['reason']}")
+
+
+# ------------------------------------------------------------------- timeline
+
+
+def build_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome-tracing / perfetto JSON from the span + utilization +
+    round event stream. Span events carry spans as (monotonic) seconds
+    since their tracer's epoch plus a ``t0_wall`` unix anchor; counter
+    tracks use the events' absolute ``t``. All timestamps shift to start
+    at 0 and are emitted in MICROseconds (the trace-event format's
+    unit), sorted ascending."""
+    slices = []   # (abs_start_s, dur_s, name, tid, depth)
+    for e in by_kind(events, "span"):
+        t0w = _fin(e.get("t0_wall")) or 0.0
+        for s in e.get("spans") or []:
+            if not isinstance(s, dict):
+                continue
+            ts, dur = _fin(s.get("ts")), _fin(s.get("dur_s"))
+            if ts is None or dur is None:
+                continue
+            slices.append((t0w + ts, max(dur, 0.0),
+                           str(s.get("name", "?")),
+                           int(s.get("tid") or 0),
+                           int(s.get("depth") or 0)))
+    counters = []  # (abs_t_s, track_name, value)
+    for e in by_kind(events, "utilization"):
+        t = _fin(e.get("t"))
+        if t is None:
+            continue
+        if _fin(e.get("mfu")) is not None:
+            counters.append((t, "MFU", e["mfu"]))
+        if _fin(e.get("input_wait_frac")) is not None:
+            counters.append((t, "input_wait_frac", e["input_wait_frac"]))
+    for e in by_kind(events, "round"):
+        t, loss = _fin(e.get("t")), _fin(e.get("loss"))
+        if t is not None and loss is not None:
+            counters.append((t, "loss", loss))
+
+    starts = [s[0] for s in slices] + [c[0] for c in counters]
+    base = min(starts) if starts else 0.0
+    trace = []
+    for start, dur, name, tid, depth in slices:
+        trace.append({"name": name, "ph": "X", "pid": 0, "tid": tid,
+                      "ts": (start - base) * 1e6, "dur": dur * 1e6,
+                      "args": {"depth": depth}})
+    for t, name, value in counters:
+        trace.append({"name": name, "ph": "C", "pid": 0,
+                      "ts": (t - base) * 1e6, "args": {name: value}})
+    trace.sort(key=lambda e: e["ts"])
+    man = next(iter(by_kind(events, "manifest")), {})
+    meta = [{"name": "process_name", "ph": "M", "pid": 0, "ts": 0,
+             "args": {"name": str(man.get("run_type", "run"))}}]
+    return {"traceEvents": meta + trace, "displayTimeUnit": "ms"}
+
+
+def timeline(events: List[Dict[str, Any]], out_path: str) -> int:
+    trace = build_trace(events)
+    n_slices = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    if n_slices == 0:
+        print("WARNING: no span events in the stream (pre-v2 telemetry, "
+              "or the run never hit the record cadence) — the trace "
+              "holds counter tracks only", file=sys.stderr)
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {out_path}: {n_slices} spans, "
+          f"{len(trace['traceEvents']) - n_slices - 1} counter samples "
+          "(open at https://ui.perfetto.dev or chrome://tracing)")
+    return 0
 
 
 # ----------------------------------------------------------------------- diff
@@ -221,6 +323,23 @@ def diff(a: List[Dict[str, Any]], b: List[Dict[str, Any]],
                 f"signals: topk_overlap {oa:.3f} -> {ob:.3f} "
                 f"(drop > {args.overlap_drop:.2f} — recovery degraded)")
 
+    ua, ub = by_kind(a, "utilization"), by_kind(b, "utilization")
+    if ua and ub:
+        ma, mb = _fin(ua[-1].get("mfu")), _fin(ub[-1].get("mfu"))
+        if ma is not None and mb is not None and ma > 0 \
+                and mb < ma * (1 - args.mfu_drop):
+            problems.append(
+                f"utilization: final mfu {ma:.4f} -> {mb:.4f} "
+                f"(> {args.mfu_drop:.0%} relative drop)")
+        wa = _fin(ua[-1].get("input_wait_frac"))
+        wb = _fin(ub[-1].get("input_wait_frac"))
+        if wa is not None and wb is not None \
+                and wb > wa + args.starvation_rise:
+            problems.append(
+                f"utilization: input_wait_frac {wa:.3f} -> {wb:.3f} "
+                f"(rise > {args.starvation_rise:.2f} — the input "
+                "pipeline started starving the chip)")
+
     def final_loss(events):
         eps = by_kind(events, "epoch")
         if eps:
@@ -263,10 +382,23 @@ def main(argv=None) -> int:
                    help="max topk_overlap absolute drop")
     d.add_argument("--loss_ratio", type=float, default=1.05,
                    help="max final loss growth factor")
+    d.add_argument("--mfu_drop", type=float, default=0.15,
+                   help="max RELATIVE drop of the final mfu (0.15 = "
+                        "15%% slower per peak-FLOP fails)")
+    d.add_argument("--starvation_rise", type=float, default=0.10,
+                   help="max ABSOLUTE rise of the final input_wait_frac")
+    t = sub.add_parser("timeline",
+                       help="render the span stream into a perfetto/"
+                            "chrome-tracing trace.json")
+    t.add_argument("path")
+    t.add_argument("-o", "--out", default="trace.json",
+                   help="output trace file (default: trace.json)")
     args = ap.parse_args(argv)
     if args.cmd == "summarize":
         summarize(load_events(args.path), label=args.path)
         return 0
+    if args.cmd == "timeline":
+        return timeline(load_events(args.path), args.out)
     if args.cmd == "diff":
         a, b = load_events(args.baseline), load_events(args.candidate)
         summarize(a, label=f"A (baseline) {args.baseline}")

@@ -429,10 +429,13 @@ def test_bench_timed_rounds_with_profiler(monkeypatch, tmp_path):
     rt = make_runtime()
     batch, mask, ids = make_batch()
     win = ProfilerWindow(str(tmp_path), "1:2", log=lambda *_: None)
-    dt, metrics = bench_common.timed_rounds(
+    dt, metrics, phases = bench_common.timed_rounds(
         rt, (ids, batch, mask, 0.05), warmup=1, rounds=3, desc="t",
         profiler=win)
     assert dt > 0 and calls == ["start", "stop"]
+    assert set(phases) == {"host_s", "dispatch_s", "device_wait_s"}
+    assert all(v >= 0 for v in phases.values())
+    assert sum(phases.values()) == pytest.approx(dt, abs=1e-3)
 
 
 # ------------------------------------------------------------ console golden
