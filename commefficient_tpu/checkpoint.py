@@ -181,6 +181,38 @@ def save_state(path: str, state: FedState, meta: Optional[Dict] = None,
     return path + ".npz"
 
 
+def save_postmortem(path: str, state: FedState,
+                    meta: Optional[Dict] = None) -> str:
+    """Flight-recorder snapshot (telemetry/health.py): ``save_state``
+    with degradation instead of refusal. A postmortem happens exactly
+    when the run is in trouble, so a state too large for the single-host
+    materialization guard must not abort the recorder — it falls back to
+    the ``ps_weights`` vector alone (the piece a replay needs first) and
+    says so in the meta sidecar. Uses the normal checkpoint format, so
+    ``load_state`` reads a full bundle back unchanged."""
+    meta = dict(meta or {})
+    try:
+        return save_state(path, state, meta)
+    except ValueError as e:
+        meta["degraded"] = f"weights-only postmortem: {e}"
+        print(f"WARNING: postmortem degraded to weights-only ({e})",
+              file=sys.stderr)
+        _atomic_savez_stream(
+            path + ".npz",
+            [("ps_weights__shape",
+              lambda: np.asarray(state.ps_weights.shape, np.int64)),
+             ("ps_weights__dtype",
+              lambda: np.asarray(str(state.ps_weights.dtype))),
+             ("ps_weights__shard0",
+              lambda: np.asarray(state.ps_weights)),
+             ("ps_weights__off0",
+              lambda: np.zeros(1, np.int64)),
+             ("__sharded__", lambda: np.asarray(1))])
+        with open(path + ".meta.json", "w") as f:
+            json.dump(meta, f)
+        return path + ".npz"
+
+
 def _shapes_need_migration(z, d_pad, num_clients, d_row_pad) -> bool:
     """Whether any stored field's shape differs from the restoring
     runtime's targets (in which case the host-side migration path must

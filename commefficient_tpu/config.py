@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed")
 ERROR_TYPES = ("none", "local", "virtual")
 DP_MODES = ("worker", "server")
+ALERT_ACTIONS = ("log", "warn", "checkpoint", "abort")
 
 # reference: CommEfficient/utils.py:37-44
 FED_DATASETS = {
@@ -284,6 +285,30 @@ class FedConfig:
     # plus two table-sized all-gathers in mesh sketch mode); they are
     # also auto-dropped under --no_telemetry, which leaves no consumer.
     signals: bool = True
+    # per-client population statistics (telemetry/clients.py): per-client
+    # loss / gradient norms pre+post clip / clip saturation / update-
+    # contribution norm / exact bytes, reduced ON DEVICE to quantile
+    # summaries along the round's client axis and emitted as schema-v3
+    # `client_stats` events at the --telemetry_every cadence (host-side
+    # participation ledger included). --no_client_stats drops them from
+    # the jitted round; like signals they are also auto-dropped under
+    # --no_telemetry (no hot-path work for a stream nobody reads).
+    client_stats: bool = True
+    # online anomaly monitor (telemetry/health.py) action when a rule
+    # fires: "log" = alert event only; "warn" = + stderr line;
+    # "checkpoint" = + one-shot flight-recorder bundle (FedState snapshot
+    # via the checkpoint layer, last-N telemetry events, alert context)
+    # into <logdir>/postmortem on the FIRST firing; "abort" = all of the
+    # above, then stop training like the NaN abort (summary records
+    # aborted=True). The monitor only exists when telemetry is on.
+    alert_action: str = "log"
+    # rolling-history length (observations) for the monitor's median/MAD
+    # z-scores; also the per-rule refire cooldown
+    alert_window: int = 32
+    # robust z-score threshold for the statistical rules (median/MAD z;
+    # 6.0 is deliberately loose — the monitor must stay silent on healthy
+    # noisy streams, see tests/test_health.py's false-positive gate)
+    alert_zscore: float = 6.0
     # heavy-hitter recovery quality (topk_overlap): compares the
     # decompressed update's support against the exact top-k of the DENSE
     # error — needs a dense reference, so it is opt-in: true_topk /
@@ -367,6 +392,9 @@ class FedConfig:
                 "(sketch, true_topk)"
         assert self.attn_impl in ("auto", "dense", "flash"), self.attn_impl
         assert self.telemetry_every >= -1, self.telemetry_every
+        assert self.alert_action in ALERT_ACTIONS, self.alert_action
+        assert self.alert_window >= 4, self.alert_window
+        assert self.alert_zscore > 0, self.alert_zscore
         if self.profile_dir:
             # a bad window spec must fail at startup, not at round START
             from commefficient_tpu.telemetry.profiling import \
@@ -612,6 +640,23 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                    default=True,
                    help="drop the per-round compression-signal health "
                         "diagnostics from the jitted round step")
+    p.add_argument("--no_client_stats", dest="client_stats",
+                   action="store_false", default=True,
+                   help="drop the per-client population statistics "
+                        "(quantile summaries + participation ledger) "
+                        "from the jitted round step")
+    p.add_argument("--alert_action", choices=ALERT_ACTIONS, default="log",
+                   help="anomaly-monitor action on a fired rule: log = "
+                        "alert event only; warn = + stderr; checkpoint = "
+                        "+ one-shot flight-recorder bundle (state "
+                        "snapshot, last-N events, alert context); abort "
+                        "= + stop training")
+    p.add_argument("--alert_window", type=int, default=32,
+                   help="rolling median/MAD history length (and per-rule "
+                        "refire cooldown) for the anomaly monitor")
+    p.add_argument("--alert_zscore", type=float, default=6.0,
+                   help="robust z-score threshold for the monitor's "
+                        "statistical rules")
     p.add_argument("--signals_exact", action="store_true",
                    help="compute topk_overlap (heavy-hitter recovery vs "
                         "the exact dense error top-k); adds an O(d) "

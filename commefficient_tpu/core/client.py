@@ -37,6 +37,11 @@ class ClientOut(NamedTuple):
     error: Optional[jax.Array]         # updated local error row (or None)
     results: Tuple[jax.Array, ...]     # (mean_loss, *metrics) over the batch
     n_valid: jax.Array                 # () number of valid datums processed
+    # per-client population stats (telemetry/clients.py CLIENT_GRAD_KEYS
+    # -> scalar), threaded only when the runtime's telemetry gating asks
+    # for them (FedRuntime._client_stats) — None otherwise so the arrays
+    # are compiled out entirely under --no_telemetry/--no_client_stats
+    stats: Optional[dict] = None
 
 
 def _num_microbatches(cfg: FedConfig, batch_size: int) -> Tuple[int, int]:
@@ -53,15 +58,24 @@ def make_forward_grad(
     unravel: Callable[[jax.Array], Any],
     batch_size: int,
     defer_encode: bool = False,
+    with_stats: bool = False,
 ):
     """Build the microbatched forward/backward (reference fed_worker.py:249-335).
 
-    Returns ``fwd(params_vec, batch, mask, rng, cs) -> (g, results, n_valid)``
-    where ``g`` is in transmitted space: the accumulated sum over microbatches
-    of per-microbatch mean gradients (matching the reference's
-    ``loss.backward()`` accumulation), with decoupled weight decay
-    ``wd/num_workers * w`` added (reference utils.py:254-259), grad-norm
-    clipping, optional DP clip+noise, and mode compression (sketch encode).
+    Returns ``fwd(params_vec, batch, mask, rng, cs) ->
+    (g, results, n_valid, stats)`` where ``g`` is in transmitted space:
+    the accumulated sum over microbatches of per-microbatch mean
+    gradients (matching the reference's ``loss.backward()``
+    accumulation), with decoupled weight decay ``wd/num_workers * w``
+    added (reference utils.py:254-259), grad-norm clipping, optional DP
+    clip+noise, and mode compression (sketch encode).
+
+    ``with_stats`` (telemetry/clients.py): also return per-client scalar
+    diagnostics — the dense gradient norm before any clip
+    (``grad_norm_pre``), after all clips and DP noise but before encode
+    (``grad_norm_post``), and whether the applicable clip actually bound
+    (``clip_frac``, NaN when no clip applies). ``stats`` is None when
+    disabled, so the extra reductions are compiled out.
     """
     num_iters, mb = _num_microbatches(cfg, batch_size)
     pad_to = num_iters * mb
@@ -115,6 +129,25 @@ def make_forward_grad(
         # runtime's aggregation, so no per-shard correction is needed here.
         if cfg.weight_decay != 0:
             g = g + (cfg.weight_decay / cfg.num_workers) * params_vec
+        stats = None
+        if with_stats:
+            # telemetry/clients.py: the clip threshold this client's
+            # gradient is measured against — DP takes precedence (its
+            # clip runs after, on the already-clipped gradient, and is
+            # the binding one for DP runs); NaN when nothing clips
+            pre = jnp.sqrt(jnp.vdot(g, g)).astype(jnp.float32)
+            if cfg.do_dp:
+                thresh = jnp.float32(cfg.l2_norm_clip)
+            elif cfg.max_grad_norm is not None and (
+                    cfg.mode != "sketch" or cfg.sketch_dense_clip):
+                thresh = jnp.float32(cfg.max_grad_norm * num_iters)
+            else:
+                thresh = jnp.float32(jnp.nan)
+            stats = {
+                "grad_norm_pre": pre,
+                "clip_frac": jnp.where(jnp.isnan(thresh), jnp.nan,
+                                       (pre > thresh).astype(jnp.float32)),
+            }
         # grad-norm clipping for dense modes (reference fed_worker.py:290-292;
         # threshold scales with the number of accumulation steps). Not
         # available seq-sharded (the runtime forbids it): the clip needs the
@@ -136,6 +169,12 @@ def make_forward_grad(
                     1.0 * cfg.num_workers) * jax.random.normal(
                         rng, g.shape, g.dtype)
                 g = g + noise
+        if with_stats:
+            # post-clip/post-noise dense norm: what this client actually
+            # contributes through the channel (measured BEFORE the
+            # sketch encode so the space matches grad_norm_pre)
+            stats["grad_norm_post"] = jnp.sqrt(
+                jnp.vdot(g, g)).astype(jnp.float32)
         # mode compression (reference fed_worker.py:312-333). When
         # ``defer_encode`` the runtime exploits sketch linearity
         # (sum-of-sketches == sketch-of-sum) to encode ONCE after the
@@ -148,7 +187,7 @@ def make_forward_grad(
                 # reference semantics: clip the TABLE (fed_worker.py:318)
                 table = cs.clip(table, cfg.max_grad_norm)
             g = table
-        return g, results, n_valid
+        return g, results, n_valid, stats
 
     return fwd
 
@@ -231,6 +270,7 @@ def make_client_step(
     unravel: Callable[[jax.Array], Any],
     batch_size: int,
     defer_encode: bool = False,
+    with_stats: bool = False,
 ):
     """Single-round client step: forward_grad + local momentum / error /
     local-topk pipeline (reference fed_worker.py:184-230).
@@ -245,11 +285,12 @@ def make_client_step(
     per-shard linear and the runtime handles the cross-shard sum/scale.
     """
     fwd = make_forward_grad(cfg, loss_fn, unravel, batch_size,
-                            defer_encode=defer_encode)
+                            defer_encode=defer_encode,
+                            with_stats=with_stats)
 
     def step(params_vec, batch, mask, velocity, error, rng,
              cs=None) -> ClientOut:
-        g, results, n_valid = fwd(params_vec, batch, mask, rng, cs)
+        g, results, n_valid, stats = fwd(params_vec, batch, mask, rng, cs)
         # weight by datum count: the server divides by the round's total
         # (reference fed_worker.py:190, fed_aggregator.py:332)
         g = g * n_valid
@@ -275,7 +316,14 @@ def make_client_step(
             if cfg.local_momentum > 0:
                 new_velocity = jnp.where(nz, 0.0, new_velocity)  # factor mask
 
-        return ClientOut(to_transmit, new_velocity, new_error, results, n_valid)
+        if stats is not None:
+            # update-contribution norm: the transmitted quantity AFTER
+            # local momentum / error feedback / local-topk — dense L2,
+            # or table Frobenius for the non-deferred sketch encode
+            stats["tx_norm"] = jnp.sqrt(
+                jnp.vdot(to_transmit, to_transmit)).astype(jnp.float32)
+        return ClientOut(to_transmit, new_velocity, new_error, results,
+                         n_valid, stats)
 
     return step
 
@@ -285,6 +333,7 @@ def make_fedavg_client(
     loss_fn: Callable,
     unravel: Callable[[jax.Array], Any],
     batch_size: int,
+    with_stats: bool = False,
 ):
     """FedAvg local-SGD loop (reference fed_worker.py:61-113).
 
@@ -324,7 +373,7 @@ def make_fedavg_client(
         def chunk_body(carry, inp):
             w, step_idx, res_acc = carry
             c_batch, c_mask, c_rng = inp
-            g, results, n_valid = fwd(w, c_batch, c_mask, c_rng)
+            g, results, n_valid, _ = fwd(w, c_batch, c_mask, c_rng)
             # fully-padded chunks (mask all zero) must be no-ops: no SGD
             # step (g would still carry the weight-decay term), no decay
             # advance, no metric contribution — the reference only ever
@@ -356,7 +405,19 @@ def make_fedavg_client(
         results = tuple(r / total for r in res_acc)
         # dataset-size weighting (reference fed_worker.py:104-108)
         transmit = (params_vec - w_final) * n_c
-        return ClientOut(transmit, None, None, results, n_c)
+        stats = None
+        if with_stats:
+            # fedavg transmits a weight delta, not a gradient: the
+            # per-chunk gradient norms are not the population signal
+            # (and straddle the local SGD trajectory), so only the
+            # update-contribution norm is meaningful — the rest stay
+            # NaN ("not applicable"), never silently zero
+            nan = jnp.full((), jnp.nan, jnp.float32)
+            stats = {"grad_norm_pre": nan, "grad_norm_post": nan,
+                     "clip_frac": nan,
+                     "tx_norm": jnp.sqrt(
+                         jnp.vdot(transmit, transmit)).astype(jnp.float32)}
+        return ClientOut(transmit, None, None, results, n_c, stats)
 
     return step
 

@@ -39,6 +39,8 @@ from commefficient_tpu.core.state import FedState
 from commefficient_tpu.ops import ravel_params
 from commefficient_tpu.ops.sketch import make_sketch_impl
 from commefficient_tpu.telemetry import tracing
+from commefficient_tpu.telemetry.clients import (CLIENT_GRAD_KEYS,
+                                                 summarize_per_client)
 from commefficient_tpu.telemetry.signals import round_signals
 from commefficient_tpu.utils.jax_compat import shard_map
 
@@ -256,6 +258,13 @@ class FedRuntime:
         # signals.py round_signals) — same availability condition
         self._signals_shadow = (self._signals_dense_cap
                                 and cfg.signals_exact)
+        # per-client population stats (telemetry/clients.py): quantile
+        # summaries of per-client loss / grad norms / clip saturation /
+        # contribution norm / bytes, reduced on device along the client
+        # axis. Gated exactly like signals — with --no_telemetry (or
+        # --no_client_stats) nothing ever reads them, so the per-client
+        # reductions are compiled out of the round entirely.
+        self._client_stats = cfg.client_stats and cfg.telemetry
 
         loss_fn_val = loss_fn_val if loss_fn_val is not None else loss_fn_train
         # Fused client gradients: when nothing nonlinear happens per client
@@ -275,9 +284,24 @@ class FedRuntime:
             and self._seq_axis is None
             and n_iters * mb == self.batch_size)
         self._fused_fn = None
+        # per-client GRADIENT stats only exist where a per-client
+        # gradient does (the vmap path and fedavg). The fused path sums
+        # every client's microbatches into ONE (d,) buffer by design —
+        # disabling it to observe would cost the measured ~15% hot-path
+        # win, so its grad-stat quantiles come out NaN instead while the
+        # loss/bytes population stats stay live (see _round_step tail).
+        # Seq-sharded rounds are excluded for CORRECTNESS, not cost:
+        # inside the shard_map each shard holds only its PARTIAL
+        # gradient, whose norm is not the client's norm (partials are
+        # not orthogonal — the same reason max_grad_norm is forbidden
+        # with a seq axis), so a per-shard norm replicated out as the
+        # client stat would be fabricated data.
+        self._client_grad_stats = (self._client_stats and not self._fused
+                                   and self._seq_axis is None)
         if cfg.mode == "fedavg":
             self._client_fn = client_lib.make_fedavg_client(
-                cfg, loss_fn_train, unravel, self.batch_size)
+                cfg, loss_fn_train, unravel, self.batch_size,
+                with_stats=self._client_grad_stats)
         elif self._fused:
             self._fused_fn = client_lib.make_fused_grad(
                 cfg, loss_fn_train, unravel, self.batch_size)
@@ -285,7 +309,8 @@ class FedRuntime:
         else:
             self._client_fn = client_lib.make_client_step(
                 cfg, loss_fn_train, unravel, self.batch_size,
-                defer_encode=self._defer_encode)
+                defer_encode=self._defer_encode,
+                with_stats=self._client_grad_stats)
         self._val_fn_inner = client_lib.make_val_step(cfg, loss_fn_val, unravel)
 
         if self.shardings is not None:
@@ -454,6 +479,7 @@ class FedRuntime:
         # ---- download byte accounting, before this round's update
         # (re-design of reference fed_aggregator.py:239-289; see state.py)
         download_bytes = upload_bytes = None
+        down_slot = up_slot = None
         client_last_round = state.client_last_round
         if cfg.track_bytes:
             thresholds = state.client_last_round[client_ids]
@@ -461,10 +487,16 @@ class FedRuntime:
             # would run W serialized full-d passes
             counts = (state.coord_last_update[None, :]
                       >= thresholds[:, None]).sum(axis=1)
+            # per-SLOT byte vectors kept alive for the client_stats
+            # quantiles (telemetry/clients.py) — the scatter below is the
+            # same data keyed by client id over the whole universe
+            down_slot = 4.0 * counts.astype(jnp.float32)
+            up_slot = jnp.full((num_workers,), 4.0 * cfg.upload_floats,
+                               jnp.float32)
             download_bytes = jnp.zeros(self.num_clients, jnp.float32).at[
-                client_ids].set(4.0 * counts.astype(jnp.float32))
+                client_ids].set(down_slot)
             upload_bytes = jnp.zeros(self.num_clients, jnp.float32).at[
-                client_ids].set(4.0 * cfg.upload_floats)
+                client_ids].set(up_slot)
             client_last_round = state.client_last_round.at[client_ids].set(
                 state.step)
 
@@ -639,7 +671,7 @@ class FedRuntime:
                 if err_out is not None:
                     err_out = rows_to_home(err_out)
             return agg, n_total, vel_out, err_out, out.results, \
-                out.n_valid, sig_dense
+                out.n_valid, sig_dense, out.stats
 
         if self._axis is not None:
             ax = self._axis
@@ -674,6 +706,10 @@ class FedRuntime:
                 tuple(row for _ in range(cfg.num_results_train)),
                 row,
                 None,   # sig_dense: never captured on a mesh (see __init__)
+                # per-client stat scalars shard like every other
+                # per-client quantity (telemetry/clients.py)
+                ({k: row for k in CLIENT_GRAD_KEYS}
+                 if self._client_grad_stats else None),
             )
             # check_vma off: the client step's scan carries start as
             # replicated zeros and become device-varying on the first
@@ -682,10 +718,12 @@ class FedRuntime:
                                      in_specs=in_specs, out_specs=out_specs,
                                      check_vma=False)
 
-        agg, n_total, vel_new, err_new, results, n_valid, sig_dense = \
-            client_block(used_weights, batch, mask, vel_rows, err_rows,
-                         client_rngs, lr, cs)
-        out = client_lib.ClientOut(None, vel_new, err_new, results, n_valid)
+        agg, n_total, vel_new, err_new, results, n_valid, sig_dense, \
+            client_grad_stats = client_block(
+                used_weights, batch, mask, vel_rows, err_rows,
+                client_rngs, lr, cs)
+        out = client_lib.ClientOut(None, vel_new, err_new, results, n_valid,
+                                   client_grad_stats)
         total = jnp.maximum(n_total, 1.0)
         agg = agg / total
         if sig_dense is not None:
@@ -716,6 +754,35 @@ class FedRuntime:
                 Vvel_new=Vvel, Verr_new=Verr, cs=cs,
                 dense_agg=sig_dense,
                 sig_vel=state.sig_Vvelocity, sig_err=state.sig_Verror)
+
+        # ---- per-client population stats (telemetry/clients.py): quantile
+        # summaries along the client axis, riding the same async metrics
+        # fetch as the loss — per-client vectors never leave the device
+        client_stats = None
+        if self._client_stats:
+            per_client = {"loss": out.results[0]}
+            if out.stats is not None:
+                per_client.update(out.stats)
+            else:
+                # fused path: no per-client gradient exists (see __init__
+                # _client_grad_stats) — NaN quantiles, never fake zeros
+                nan_w = jnp.full((num_workers,), jnp.nan, jnp.float32)
+                per_client.update({k: nan_w for k in CLIENT_GRAD_KEYS})
+            if cfg.track_bytes:
+                per_client["upload_bytes"] = up_slot
+                per_client["download_bytes"] = down_slot
+            rep = None
+            if self.mesh is not None:
+                # one W-sized all-gather for the WHOLE summary: without
+                # the replication constraint every per-key quantile
+                # lowers to its own tiny collectives (launch-count
+                # pathology, see summarize_per_client)
+                rep_sh = NamedSharding(self.mesh, P())
+
+                def rep(x, _sh=rep_sh):
+                    return lax.with_sharding_constraint(x, _sh)
+            client_stats = summarize_per_client(per_client, out.n_valid,
+                                                replicate_fn=rep)
 
         if self.d_pad != cfg.grad_size:
             if update.shape[0] == cfg.grad_size:
@@ -786,6 +853,7 @@ class FedRuntime:
             "download_bytes": download_bytes,
             "upload_bytes": upload_bytes,
             "signals": signals,              # dict of scalars, or None
+            "client_stats": client_stats,    # quantile summaries, or None
         }
         return new_state, metrics
 

@@ -37,6 +37,9 @@ from commefficient_tpu.losses import make_cv_loss
 from commefficient_tpu.telemetry import (ProfilerWindow, UtilizationTracker,
                                          tracing)
 from commefficient_tpu.telemetry import maybe_create as make_telemetry
+from commefficient_tpu.telemetry.clients import (ParticipationLedger,
+                                                 client_stats_to_host)
+from commefficient_tpu.telemetry.health import AnomalyMonitor, FlightRecorder
 from commefficient_tpu.utils import (
     PiecewiseLinear,
     TableLogger,
@@ -272,6 +275,7 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
     # exists — with --no_telemetry the process-global tracer stays the
     # NullTracer and every span site is a shared no-op context manager
     tracer = util = None
+    monitor = recorder = ledger = None
     if telemetry is not None:
         tracer = tracing.install()
         util = UtilizationTracker(telemetry, peak_flops=cfg.peak_flops,
@@ -280,6 +284,21 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
             # analytic MFU numerator (gpt2_train passes one: XLA's cost
             # analysis under-counts scanned rounds, models/gpt2.py)
             util.set_flops_per_round(model_flops_per_round)
+        # online anomaly monitor (telemetry/health.py): fed every
+        # monitored event the stream writes (set_monitor forwarding);
+        # under --alert_action checkpoint/abort the flight recorder
+        # snapshots state + recent events on the FIRST fired rule
+        monitor = AnomalyMonitor(telemetry, action=cfg.alert_action,
+                                 window=cfg.alert_window,
+                                 z_thresh=cfg.alert_zscore)
+        telemetry.set_monitor(monitor)
+        if cfg.alert_action in ("checkpoint", "abort"):
+            recorder = FlightRecorder(telemetry.logdir, telemetry)
+        if cfg.client_stats:
+            # host-side participation accounting over the whole client
+            # universe — observes the sampler's (host-resident) ids, so
+            # it costs no device traffic and runs EVERY round
+            ledger = ParticipationLedger(train_ds.num_clients)
     # device-resident data path: upload the dataset once, gather + augment
     # each round's batch on device, accumulate metrics on device, and fetch
     # once per epoch — a host<->device transfer costs ~170 ms latency on
@@ -381,6 +400,10 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                 # captured, so the host fetch + JSONL writes below (and
                 # their flush latency) land in NO measured phase — they
                 # are visible instead as the telemetry_emit span
+                if ledger is not None:
+                    # sampler ids/mask are host arrays: no device fetch
+                    ledger.observe(global_round, rnd.client_ids,
+                                   np.asarray(rnd.mask).sum(axis=1))
                 if record:
                     with tracing.span("telemetry_emit"):
                         res = [np.asarray(r) for r in metrics["results"]]
@@ -421,11 +444,48 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                                 upload_bytes=up_total,
                                 client_download_bytes=down_clients,
                                 client_upload_bytes=up_clients)
+                        if metrics.get("client_stats") is not None \
+                                and ledger is not None:
+                            # per-client population quantiles (device-
+                            # reduced, telemetry/clients.py) + the
+                            # participation ledger snapshot
+                            telemetry.client_stats_event(
+                                rnd=global_round,
+                                n_participants=len(
+                                    np.asarray(rnd.client_ids)),
+                                quantiles=client_stats_to_host(
+                                    metrics["client_stats"],
+                                    rnd.client_ids),
+                                participation=ledger.snapshot(
+                                    global_round))
                         # MFU/starvation over the window since the last
                         # record, and the window's spans — the tail of
                         # this round's trace lands in the next drain
                         util.emit(global_round)
                     telemetry.span_event(tracer)
+                    # ---- alert actions (telemetry/health.py): the
+                    # monitor already wrote its alert events while the
+                    # records above were emitted; here the driver owns
+                    # the side effects that need the live state
+                    if recorder is not None:
+                        req = monitor.pop_snapshot_request()
+                        if req is not None:
+                            recorder.record(state, req)
+                    if monitor is not None and monitor.abort_requested:
+                        last = monitor.alerts[-1]
+                        print(f"ALERT ABORT (--alert_action abort): rule "
+                              f"{last['rule']} on {last['metric']} at "
+                              f"round {last['round']}, TERMINATING")
+                        prof.finalize(lambda: jax.block_until_ready(
+                            state.ps_weights))
+                        telemetry.span_event(tracer)
+                        telemetry.write_summary(
+                            aborted=True, n_rounds=rounds_run + 1,
+                            total_download_mib=total_download_mb,
+                            total_upload_mib=total_upload_mb,
+                            final=telemetry.last_epoch)
+                        telemetry.fsync()
+                        return state, None
                 rounds_run += 1
                 if telemetry is not None and rounds_run == 1:
                     # device memory after the first round: weights + server
@@ -465,6 +525,18 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                 print(f"TRAINING DIVERGED ({which}), TERMINATING")
                 prof.finalize(lambda: jax.block_until_ready(state.ps_weights))
                 if telemetry is not None:
+                    # a postmortem's LAST events name what killed the
+                    # run: a final critical alert, then the structured
+                    # nan_abort — and the flight recorder (when armed)
+                    # snapshots the state/events before the return
+                    telemetry.alert_event(
+                        rnd=nan_round if nan_round >= 0 else global_round,
+                        rule="nonfinite_abort", severity="critical",
+                        metric="loss", action=cfg.alert_action)
+                    if recorder is not None:
+                        recorder.record(state, {
+                            "rule": "nonfinite_abort", "reason": which,
+                            "round": int(nan_round)})
                     # structured divergence diagnostic: which round went
                     # non-finite, under what mode/clip/sketch config, and the
                     # last records known finite — instead of only the bare
@@ -477,6 +549,10 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                         total_download_mib=total_download_mb,
                         total_upload_mib=total_upload_mb,
                         final=telemetry.last_epoch)
+                    # never hand a truncated stream to the postmortem:
+                    # everything above must survive the process dying
+                    # right after this return (BENCH_r02 lesson, fsync'd)
+                    telemetry.fsync()
                 return state, None
             total = max(float(sums[2]), 1.0)
             train_loss = float(sums[0]) / total
@@ -509,6 +585,25 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                 telemetry.epoch_event(summary, test_time=test_time)
                 telemetry.memory_event(f"epoch_{epoch + 1}")
                 telemetry.span_event(tracer)  # incl. the validation span
+                # rules fired by the epoch-boundary utilization flush
+                # (e.g. mfu_cliff) get their side effects here, not a
+                # full record-cadence later
+                if recorder is not None:
+                    req = monitor.pop_snapshot_request()
+                    if req is not None:
+                        recorder.record(state, req)
+                if monitor is not None and monitor.abort_requested:
+                    last = monitor.alerts[-1]
+                    print(f"ALERT ABORT (--alert_action abort): rule "
+                          f"{last['rule']} on {last['metric']} at round "
+                          f"{last['round']}, TERMINATING")
+                    telemetry.write_summary(
+                        aborted=True, n_rounds=rounds_run,
+                        total_download_mib=total_download_mb,
+                        total_upload_mib=total_upload_mb,
+                        final=telemetry.last_epoch)
+                    telemetry.fsync()
+                    return state, None
             if writer is not None:
                 # reference scalar set (cv_train.py:150-158)
                 writer.add_scalar("Loss/train", train_loss, epoch)

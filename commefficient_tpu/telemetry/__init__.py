@@ -7,10 +7,19 @@ shared by ``cv_train.py``, ``gpt2_train.py``, ``bench.py`` and
 README.md ("Telemetry & profiling") for the consumer-facing contract;
 ``scripts/teleview.py`` summarizes and diffs the streams offline."""
 
+from commefficient_tpu.telemetry.clients import (CLIENT_STAT_KEYS,
+                                                 ParticipationLedger,
+                                                 client_stats_to_host,
+                                                 quantiles_ordered,
+                                                 summarize_per_client)
 from commefficient_tpu.telemetry.collectives import (ledger_from_compiled,
                                                      ledger_from_hlo,
                                                      round_ledger,
                                                      summarize_ledger)
+from commefficient_tpu.telemetry.health import (MONITORED_KINDS,
+                                                AnomalyMonitor,
+                                                FlightRecorder,
+                                                robust_z)
 from commefficient_tpu.telemetry.compilewatch import JitWatcher
 from commefficient_tpu.telemetry.profiling import (ProfilerWindow,
                                                    parse_profile_rounds)
@@ -30,6 +39,15 @@ from commefficient_tpu.telemetry.utilization import (PEAK_FLOPS_BY_KIND,
                                                      peak_flops_for)
 
 __all__ = [
+    "CLIENT_STAT_KEYS",
+    "ParticipationLedger",
+    "client_stats_to_host",
+    "quantiles_ordered",
+    "summarize_per_client",
+    "MONITORED_KINDS",
+    "AnomalyMonitor",
+    "FlightRecorder",
+    "robust_z",
     "JitWatcher",
     "ProfilerWindow",
     "parse_profile_rounds",

@@ -1,0 +1,303 @@
+"""Online anomaly detection over the telemetry streams, and the flight
+recorder that snapshots state before a run dies.
+
+PRs 1-3 RECORD everything — round losses, compression-signal norms, MFU,
+and now per-client population quantiles — but nothing WATCHES the
+recordings: round 5's measured-divergent regimes (the subtract-EF arms,
+the local_topk leak) drifted for dozens of rounds with their error norms
+growing in plain sight, and the device-side ``nan_round`` flag only
+fires *after* the state is poisoned. :class:`AnomalyMonitor` closes the
+loop: it keeps a rolling median/MAD history of a small set of watched
+stream fields and raises a schema-v3 ``alert`` event when a robust
+z-score leaves the envelope — loss spikes, EF-accumulator blowups,
+heavy-hitter recovery collapse, MFU cliffs, client-population loss
+spread — plus nonfinite-precursor rules (a watched metric that WAS
+numeric turning null is the last observable event before the abort).
+
+Median/MAD (not mean/std) on purpose: the history will CONTAIN the
+anomalies it is trying to flag, and a single spike must not drag the
+envelope after it. The MAD is floored at 2% of |median| so quantized
+metrics (rounded MFU) cannot fire on noise.
+
+``--alert_action`` escalates what a fired rule does:
+
+- ``log``        — the alert event only (always written);
+- ``warn``       — + one stderr line;
+- ``checkpoint`` — + the :class:`FlightRecorder` writes a ONE-SHOT
+  postmortem bundle on the first firing: the live ``FedState`` through
+  the existing checkpoint layer, the last-N telemetry events, and the
+  alert context — so the round that *precedes* a NaN is preserved for
+  replay instead of dying with the process;
+- ``abort``      — + the driver stops training (summary records
+  ``aborted=True``), mirroring the NaN abort.
+
+Feeding is wired through ``RunTelemetry.set_monitor``: every monitored
+event the stream writes is forwarded here after serialization, so the
+monitor sees exactly what a postmortem reader will see (NaN already
+null). Dependency-free (no jax/numpy in the detection path) — the same
+rules run identically under ``teleview alerts --replay`` on a machine
+without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+SEVERITIES = ("info", "warn", "critical")
+# event kinds RunTelemetry forwards to an attached monitor
+MONITORED_KINDS = ("round", "signals", "utilization", "client_stats")
+
+# The rule table: each rule watches ONE field of ONE event kind.
+# kind="z" fires on a robust z-score breach of the rolling history
+# (direction high/low); kind="nonfinite" fires when a field that has
+# numeric history arrives null (the nonfinite-precursor counter —
+# fields that are null because they are N/A for the mode never fire,
+# since they never build numeric history).
+RULES = (
+    dict(name="loss_spike", event="round", field="loss",
+         kind="z", direction="high", severity="warn"),
+    dict(name="loss_nonfinite", event="round", field="loss",
+         kind="nonfinite", severity="critical"),
+    dict(name="grad_norm_spike", event="signals", field="grad_norm",
+         kind="z", direction="high", severity="warn"),
+    dict(name="error_norm_blowup", event="signals", field="error_norm",
+         kind="z", direction="high", severity="critical"),
+    dict(name="velocity_norm_blowup", event="signals",
+         field="velocity_norm", kind="z", direction="high",
+         severity="critical"),
+    dict(name="update_nonfinite", event="signals", field="update_norm",
+         kind="nonfinite", severity="critical"),
+    dict(name="topk_overlap_collapse", event="signals",
+         field="topk_overlap", kind="z", direction="low", severity="warn"),
+    dict(name="mfu_cliff", event="utilization", field="mfu",
+         kind="z", direction="low", severity="warn"),
+    dict(name="input_starvation", event="utilization",
+         field="input_wait_frac", kind="z", direction="high",
+         severity="info"),
+    dict(name="client_loss_spread", event="client_stats",
+         field="loss_spread", kind="z", direction="high", severity="warn"),
+)
+
+
+def _extract(rule: Dict[str, Any], fields: Dict[str, Any]) -> Any:
+    """Pull the watched value out of one event's fields. Derived metric:
+    ``client_stats.loss_spread`` = p95 - p5 of the per-client loss
+    quantiles (the population-divergence signal)."""
+    if rule["event"] == "client_stats" and rule["field"] == "loss_spread":
+        q = (fields.get("quantiles") or {}).get("loss") or {}
+        hi, lo = q.get("p95"), q.get("p5")
+        if isinstance(hi, (int, float)) and isinstance(lo, (int, float)):
+            return float(hi) - float(lo)
+        return None
+    return fields.get(rule["field"])
+
+
+def robust_z(value: float, history: List[float],
+             mad_floor_frac: float = 0.02) -> Dict[str, float]:
+    """Median/MAD z-score of ``value`` against ``history`` (the standard
+    0.6745 normal-consistency factor, so z compares to sigma units).
+    The MAD is floored at ``mad_floor_frac * |median|`` (and an absolute
+    epsilon) so a constant or quantized history cannot make every
+    deviation infinite."""
+    xs = sorted(history)
+    n = len(xs)
+    med = (xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2]))
+    dev = sorted(abs(x - med) for x in xs)
+    mad = (dev[n // 2] if n % 2 else 0.5 * (dev[n // 2 - 1] + dev[n // 2]))
+    mad = max(mad, mad_floor_frac * abs(med), 1e-12)
+    return {"zscore": 0.6745 * (value - med) / mad, "median": med,
+            "mad": mad}
+
+
+class AnomalyMonitor:
+    """Watches the monitored event kinds and fires rule alerts.
+
+    ``observe(kind, fields)`` is the single entry point (RunTelemetry
+    forwards through it); it returns the list of alerts fired by that
+    event, after writing each as an ``alert`` telemetry event and
+    applying the configured action's side effects (stderr warn, snapshot
+    request, abort request). A fired rule goes quiet for ``cooldown``
+    observations of its metric — a spike fires once, not once per
+    follow-up read while the history catches up.
+    """
+
+    def __init__(self, telemetry=None, *, action: str = "log",
+                 window: int = 32, z_thresh: float = 6.0,
+                 min_points: int = 8, cooldown: Optional[int] = None,
+                 rules=RULES):
+        assert action in ("log", "warn", "checkpoint", "abort"), action
+        self._telemetry = telemetry
+        self.action = action
+        self.window = int(window)
+        self.z_thresh = float(z_thresh)
+        # a window smaller than min_points would otherwise gate every
+        # statistical rule off forever (the deque can never hold enough
+        # history) — tightening --alert_window must tighten, not disarm
+        self.min_points = min(int(min_points), self.window)
+        self.cooldown = int(cooldown if cooldown is not None else window)
+        self.rules = tuple(rules)
+        self._hist: Dict[str, deque] = {}
+        self._quiet: Dict[str, int] = {}      # rule name -> obs remaining
+        self.alerts: List[Dict[str, Any]] = []
+        self.nonfinite_counts: Dict[str, int] = {}
+        self.n_observed = 0
+        self.abort_requested = False
+        self._snapshot_request: Optional[Dict[str, Any]] = None
+
+    @property
+    def armed(self) -> bool:
+        """The dryrun predicate: the monitor exists, has rules, and is
+        attached to a stream it can write alerts into."""
+        return bool(self.rules) and self._telemetry is not None
+
+    def pop_snapshot_request(self) -> Optional[Dict[str, Any]]:
+        """The first checkpoint/abort-worthy alert's context, once —
+        the driver hands it to the FlightRecorder with the live state
+        (the monitor never holds device arrays itself)."""
+        req, self._snapshot_request = self._snapshot_request, None
+        return req
+
+    # ------------------------------------------------------------- observing
+
+    def observe(self, kind: str, fields: Dict[str, Any]
+                ) -> List[Dict[str, Any]]:
+        if kind not in MONITORED_KINDS:
+            return []
+        self.n_observed += 1
+        rnd = fields.get("round", -1)
+        rnd = rnd if isinstance(rnd, int) else -1
+        fired: List[Dict[str, Any]] = []
+        appended: set = set()
+        for rule in self.rules:
+            if rule["event"] != kind:
+                continue
+            name = rule["name"]
+            metric = f"{kind}.{rule['field']}"
+            value = _extract(rule, fields)
+            numeric = (isinstance(value, (int, float))
+                       and not isinstance(value, bool)
+                       and math.isfinite(value))
+            hist = self._hist.setdefault(metric, deque(maxlen=self.window))
+            quiet = self._quiet.get(name, 0)
+            if quiet > 0:
+                self._quiet[name] = quiet - 1
+            alert = None
+            if rule["kind"] == "nonfinite":
+                # only a metric that WAS numeric turning null is a
+                # precursor; an always-null field is merely N/A
+                if not numeric and value is None and len(hist) > 0:
+                    self.nonfinite_counts[metric] = (
+                        self.nonfinite_counts.get(metric, 0) + 1)
+                    if quiet <= 0:
+                        alert = dict(round=rnd, rule=name,
+                                     severity=rule["severity"],
+                                     metric=metric, value=None, zscore=None,
+                                     median=None, mad=None,
+                                     window=len(hist), action=self.action)
+            elif numeric and len(hist) >= self.min_points and quiet <= 0:
+                stats = robust_z(float(value), list(hist))
+                z = stats["zscore"]
+                breach = (z > self.z_thresh
+                          if rule.get("direction") == "high"
+                          else z < -self.z_thresh)
+                if breach:
+                    alert = dict(round=rnd, rule=name,
+                                 severity=rule["severity"], metric=metric,
+                                 value=float(value),
+                                 zscore=round(z, 4),
+                                 median=stats["median"],
+                                 mad=stats["mad"],
+                                 window=len(hist), action=self.action)
+            # the observed value enters the history AFTER detection, so
+            # the spike itself cannot vouch for its own normality —
+            # and only ONCE per event, even when several rules watch
+            # the same metric (loss_spike + loss_nonfinite would
+            # otherwise double-append and halve the effective window)
+            if numeric and metric not in appended:
+                hist.append(float(value))
+                appended.add(metric)
+            if alert is not None:
+                self._quiet[name] = self.cooldown
+                fired.append(alert)
+        for alert in fired:
+            self._fire(alert)
+        return fired
+
+    # --------------------------------------------------------------- actions
+
+    def _fire(self, alert: Dict[str, Any]) -> None:
+        self.alerts.append(alert)
+        if self._telemetry is not None:
+            self._telemetry.event("alert", **alert)
+        if self.action != "log":
+            z = alert.get("zscore")
+            print(f"ALERT [{alert['severity']}] {alert['rule']}: "
+                  f"{alert['metric']}={alert.get('value')}"
+                  + (f" (robust z {z:+.1f})" if z is not None else "")
+                  + f" at round {alert['round']}", file=sys.stderr)
+        if self.action in ("checkpoint", "abort"):
+            if self._snapshot_request is None:
+                self._snapshot_request = dict(alert)
+        if self.action == "abort":
+            self.abort_requested = True
+
+
+class FlightRecorder:
+    """One-shot postmortem bundle writer (``--alert_action checkpoint``).
+
+    ``record(state, context)`` writes, into ``<logdir>/postmortem/``:
+
+    - ``state.npz`` + ``state.meta.json`` — the live ``FedState``
+      through the existing checkpoint layer
+      (:func:`commefficient_tpu.checkpoint.save_postmortem`; a state too
+      large for the single-host guard degrades to weights-only, never
+      fails the run);
+    - ``events.jsonl`` — the stream's last-N events (the RunTelemetry
+      ring buffer), so the bundle replays without the full stream;
+    - ``alert.json`` — the firing alert's context.
+
+    One-shot: the FIRST alert owns the bundle (the interesting state is
+    the earliest anomalous one — later alerts describe decay of a run
+    the bundle already captured). Best-effort like all telemetry: a
+    failed write warns and disables, never raises into the round loop.
+    """
+
+    def __init__(self, logdir: str, telemetry=None,
+                 subdir: str = "postmortem"):
+        self.path = os.path.join(logdir, subdir)
+        self._telemetry = telemetry
+        self.written: Optional[str] = None
+
+    def record(self, state, context: Dict[str, Any]) -> Optional[str]:
+        if self.written is not None:
+            return self.written
+        try:
+            from commefficient_tpu.checkpoint import save_postmortem
+            os.makedirs(self.path, exist_ok=True)
+            save_postmortem(os.path.join(self.path, "state"), state,
+                            meta={"alert": context})
+            if self._telemetry is not None:
+                with open(os.path.join(self.path, "events.jsonl"),
+                          "w") as f:
+                    for ev in self._telemetry.recent:
+                        f.write(json.dumps(ev) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                # the stream itself must survive whatever comes next
+                self._telemetry.fsync()
+            with open(os.path.join(self.path, "alert.json"), "w") as f:
+                json.dump(context, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+        except Exception as e:  # noqa: BLE001 - observability never kills
+            print(f"WARNING: flight recorder failed ({e})", file=sys.stderr)
+            return None
+        self.written = self.path
+        print(f"flight recorder: postmortem bundle at {self.path}",
+              file=sys.stderr)
+        return self.written

@@ -11,6 +11,7 @@ telemetry failure only disables telemetry, never the run.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import math
@@ -79,8 +80,13 @@ class RunTelemetry:
         self._file = None
         self._counts: Dict[str, int] = {}
         self._watcher: Optional[JitWatcher] = None
+        self._monitor = None
         self.last_round: Optional[Dict[str, Any]] = None
         self.last_epoch: Optional[Dict[str, Any]] = None
+        # ring buffer of recent serialized events — the flight recorder's
+        # "last N events before it died" (telemetry/health.py); 256 covers
+        # several record windows of every event type at trivial memory
+        self.recent: collections.deque = collections.deque(maxlen=256)
         try:
             os.makedirs(logdir, exist_ok=True)
             self._file = open(self.path, "w")
@@ -135,6 +141,11 @@ class RunTelemetry:
             # the stream must never contain tokens strict parsers reject
             self._file.write(json.dumps(record, allow_nan=False) + "\n")
             self._file.flush()
+            if kind in ("alert", "nan_abort", "summary"):
+                # the events a postmortem reader needs most are exactly
+                # the ones written while the run is dying: push them
+                # through the OS cache so a crash cannot truncate them
+                os.fsync(self._file.fileno())
         except (OSError, ValueError) as e:
             print(f"WARNING: telemetry write failed, disabling ({e})",
                   file=sys.stderr)
@@ -146,6 +157,7 @@ class RunTelemetry:
             return
         self._seq += 1
         self._counts[kind] = self._counts.get(kind, 0) + 1
+        self.recent.append(record)
         if kind == "round":
             # last_round feeds nan_abort as "last record known FINITE":
             # a record whose loss/acc went non-finite (serialized null)
@@ -155,6 +167,30 @@ class RunTelemetry:
                 self.last_round = record
         elif kind == "epoch":
             self.last_epoch = record
+        if self._monitor is not None:
+            # feed the anomaly monitor AFTER serialization so it sees
+            # exactly what a postmortem reader will see (NaN -> null);
+            # alerts it fires come back through event() with kind
+            # "alert", which is not monitored — no recursion
+            from commefficient_tpu.telemetry.health import MONITORED_KINDS
+            if kind in MONITORED_KINDS:
+                self._monitor.observe(kind, record)
+
+    def set_monitor(self, monitor) -> None:
+        """Attach a health.AnomalyMonitor: every monitored event written
+        to the stream is forwarded to it (see event())."""
+        self._monitor = monitor
+
+    def fsync(self) -> None:
+        """Force the stream through the OS cache — the abort paths call
+        this so a postmortem is never truncated by the death it
+        documents. Safe on a closed/disabled stream."""
+        if self._file is not None:
+            try:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+            except OSError:
+                pass
 
     def close(self) -> None:
         if self._file is not None:
@@ -265,6 +301,31 @@ class RunTelemetry:
                    download_bytes=download_bytes, upload_bytes=upload_bytes,
                    client_download_bytes=client_download_bytes,
                    client_upload_bytes=client_upload_bytes)
+
+    def client_stats_event(self, *, rnd: int, n_participants: int,
+                           quantiles: Dict[str, Any],
+                           participation: Dict[str, Any]) -> None:
+        """Per-client population summary for one round
+        (telemetry/clients.py): the device-reduced quantiles joined with
+        the host-side participation ledger snapshot — same cadence, same
+        host sync as the round record."""
+        self.event("client_stats", round=int(rnd),
+                   n_participants=int(n_participants),
+                   quantiles=quantiles, **participation)
+
+    def alert_event(self, *, rnd: int, rule: str, severity: str,
+                    metric: str, value: Optional[float] = None,
+                    zscore: Optional[float] = None,
+                    median: Optional[float] = None,
+                    mad: Optional[float] = None, window: int = 0,
+                    action: str = "log") -> None:
+        """One anomaly alert (telemetry/health.py normally emits these
+        through the monitor; the drivers use this directly for the final
+        nonfinite-abort alert so a postmortem's LAST event before the
+        nan_abort names the rule that killed the run)."""
+        self.event("alert", round=int(rnd), rule=rule, severity=severity,
+                   metric=metric, value=value, zscore=zscore, median=median,
+                   mad=mad, window=int(window), action=action)
 
     def span_event(self, tracer) -> None:
         """Drain a tracing.SpanTracer's completed spans into one batched

@@ -20,12 +20,13 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterable, List, Tuple
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 # streams written by older code stay readable: v1 lacks the span /
-# utilization event types (added in v2) but is otherwise identical, so
-# the validator accepts any supported manifest version — a version it
-# does not know is the error, not a version merely older than current
-SUPPORTED_SCHEMA_VERSIONS = (1, SCHEMA_VERSION)
+# utilization event types (added in v2), v2 lacks client_stats / alert
+# (added in v3), but each is otherwise a subset of its successor — so
+# the validator accepts any supported manifest version. A version it
+# does not know is the error, not a version merely older than current.
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, SCHEMA_VERSION)
 TELEMETRY_BASENAME = "telemetry.jsonl"
 
 
@@ -218,6 +219,43 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "dispatch_frac": _opt_num,
         "device_wait_frac": _opt_num,
         "straggler_spread": _opt_num,  # (max-min)/mean per-host device_s
+    },
+    # per-client population summary for one round (telemetry/clients.py):
+    # on-device quantile reductions over the round's client axis (the
+    # full (W,) vectors never reach the stream — JSONL stays small at
+    # num_workers=512) joined with the host-side participation ledger.
+    # ``quantiles`` maps each stat key (loss, grad_norm_pre/post,
+    # clip_frac, tx_norm, upload/download_bytes) to
+    # {p5,p25,p50,p75,p95,max,mean,argmax_client}; values are null where
+    # the stat does not exist for the mode/path (e.g. per-client grad
+    # norms under the fused-clients fast path) — never silently zero
+    "client_stats": {
+        "round": _int,
+        "n_participants": _int,       # client slots in this round
+        "quantiles": _dict,
+        "coverage": _num,             # distinct participants / num_clients
+        "distinct_clients": _int,     # seen at least once so far
+        "counts_p50": _opt_num,       # per-seen-client sample counts
+        "counts_max": _opt_num,
+        "staleness_p50": _opt_num,    # rounds since last participation
+        "staleness_max": _opt_num,
+    },
+    # online anomaly alert (telemetry/health.py): a monitor rule fired
+    # against the rolling median/MAD history of a watched stream field.
+    # zscore/median/mad are null for non-statistical rules (nonfinite
+    # precursors); ``action`` records the configured --alert_action so
+    # postmortems know whether a flight-recorder bundle should exist
+    "alert": {
+        "round": _int,
+        "rule": _str,
+        "severity": _str,             # info | warn | critical
+        "metric": _str,
+        "value": _opt_num,
+        "zscore": _opt_num,
+        "median": _opt_num,
+        "mad": _opt_num,
+        "window": _int,
+        "action": _str,               # log | warn | checkpoint | abort
     },
     # end-of-run footer
     "summary": {

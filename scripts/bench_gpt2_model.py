@@ -82,7 +82,7 @@ def main():
 
         def chain(p, n):
             def body(carry, _):
-                g, res, nv = vfwd(carry, batch, mask, rngs)
+                g, res, nv, _ = vfwd(carry, batch, mask, rngs)
                 # serialize: next step's params depend on this gradient
                 return carry - 1e-12 * g.sum(axis=0), res[0].mean()
             p_out, losses = jax.lax.scan(body, p, None, length=n)

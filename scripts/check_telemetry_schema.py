@@ -49,6 +49,31 @@ _SAMPLE_OVERRIDES = {
               {"name": "round_dispatch", "ts": 0.01, "dur_s": 0.02,
                "tid": 0, "depth": 1}],
     "flops_source": "cost_analysis",
+    # client_stats: one realistic per-stat quantile record (ordered
+    # quantiles, a null not-applicable stat) + participation fields
+    "quantiles": {
+        "loss": {"p5": 0.5, "p25": 0.8, "p50": 1.0, "p75": 1.3,
+                 "p95": 1.9, "max": 2.0, "mean": 1.1,
+                 "argmax_client": 3},
+        "grad_norm_pre": {"p5": None, "p25": None, "p50": None,
+                          "p75": None, "p95": None, "max": None,
+                          "mean": None, "argmax_client": None},
+    },
+    "coverage": 0.5,
+    "distinct_clients": 4,
+    "counts_p50": 8.0,
+    "counts_max": 16.0,
+    "staleness_p50": 1.0,
+    "staleness_max": 3.0,
+    # alert: a fired statistical rule
+    "rule": "loss_spike",
+    "severity": "warn",
+    "metric": "round.loss",
+    "zscore": 8.5,
+    "median": 1.0,
+    "mad": 0.1,
+    "window": 32,
+    "action": "log",
 }
 
 

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# The single cheap green signal: schema selftest (generator and
+# validator vocabularies agree, incl. the v3 client_stats/alert types),
+# committed-artifact schema lint, then the tier-1 suite exactly as
+# ROADMAP.md specifies it (CPU backend, slow tests deselected).
+#
+# Usage: scripts/ci_fast.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python scripts/check_telemetry_schema.py --selftest runs
+
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly "$@" 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+exit "$rc"

@@ -3,10 +3,12 @@
 
 BENCH/MULTICHIP comparisons have been manual JSON spelunking — ``jq``
 one-liners against artifacts whose schema only the writers knew. This
-CLI reads one stream (``summarize``), two (``diff``), or renders one
-into a timeline (``timeline``):
+CLI reads one stream (``summarize``/``alerts``/``clients``), two
+(``diff``), or renders one into a timeline (``timeline``):
 
     python scripts/teleview.py summarize runs/x/telemetry.jsonl
+    python scripts/teleview.py alerts runs/x/telemetry.jsonl
+    python scripts/teleview.py clients runs/x/telemetry.jsonl
     python scripts/teleview.py diff old/telemetry.jsonl new/telemetry.jsonl
     python scripts/teleview.py timeline runs/x/telemetry.jsonl -o trace.json
 
@@ -14,7 +16,16 @@ into a timeline (``timeline``):
 (per watched executable: launch counts by kind, payload bytes), a
 sampled round table, per-signal trends (first/last/min/max of every
 signals.py key), the MFU/starvation line from the ``utilization``
-events, and the epoch table.
+events, alert/abort lines, and the epoch table.
+
+``alerts`` lists every ``alert`` event (rule, severity, metric, value,
+robust z) plus the nan_abort, and exits 1 when any critical alert (or
+abort) is present — the postmortem triage entry point for a stream a
+crashed run left behind. ``clients`` renders the ``client_stats``
+population trends: per-stat p50/p95/max first->last, participation
+coverage/staleness, and the clients that most often owned the round
+maximum. Both run jax-free, and both tolerate the truncated trailing
+line a crashed writer leaves (see ``load_events``).
 
 ``timeline`` renders the ``span`` event stream (telemetry/tracing.py)
 into a perfetto / chrome-tracing ``trace.json`` — complete ("X") slice
@@ -51,19 +62,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 try:
     # single source of truth when the package is importable...
+    from commefficient_tpu.telemetry.clients import CLIENT_STAT_KEYS
     from commefficient_tpu.telemetry.schema import TELEMETRY_BASENAME
     from commefficient_tpu.telemetry.signals import SIGNAL_KEYS
 except ImportError:
     # ...but the analyzer must work on a machine WITHOUT jax (analyzing
     # a downloaded artifact is the whole point of an offline tool, and
     # the telemetry package import pulls jax in transitively). These
-    # literals mirror the canonical values; tests/test_signals.py pins
-    # them against the package.
+    # literals mirror the canonical values; tests/test_signals.py and
+    # tests/test_clients.py pin them against the package.
     TELEMETRY_BASENAME = "telemetry.jsonl"
     SIGNAL_KEYS = (
         "grad_norm", "grad_true_norm", "grad_l2estimate",
         "velocity_norm", "error_norm", "error_l2estimate",
         "update_norm", "support_density", "topk_overlap",
+    )
+    CLIENT_STAT_KEYS = (
+        "loss", "grad_norm_pre", "grad_norm_post", "clip_frac",
+        "tx_norm", "upload_bytes", "download_bytes",
     )
 
 NORM_KEYS = ("grad_norm", "grad_true_norm", "grad_l2estimate",
@@ -76,16 +92,27 @@ def load_events(path: str) -> List[Dict[str, Any]]:
         path = os.path.join(path, TELEMETRY_BASENAME)
     events = []
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                obj = json.loads(line)
-            except ValueError:
-                continue  # check_telemetry_schema flags these; keep reading
-            if isinstance(obj, dict):
-                events.append(obj)
+        lines = [ln.strip() for ln in f]
+    while lines and not lines[-1]:
+        lines.pop()
+    for i, line in enumerate(lines):
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            # a crashed run's stream legitimately ends mid-write: the
+            # analyzer's whole job is reading exactly those streams, so
+            # a truncated TRAILING line is a note, not an error (a bad
+            # line mid-stream is still skipped — the schema linter
+            # flags it; keep reading either way)
+            if i == len(lines) - 1:
+                print(f"note: {os.path.basename(path)} ends in a "
+                      "truncated line (crashed run?) — ignored",
+                      file=sys.stderr)
+            continue
+        if isinstance(obj, dict):
+            events.append(obj)
     return events
 
 
@@ -202,6 +229,27 @@ def summarize(events: List[Dict[str, Any]], label: str = "") -> None:
                   f"{fmt(e['test_acc'])} up {fmt(e['upload_mib'], '.0f')}"
                   " MiB")
 
+    cstats = by_kind(events, "client_stats")
+    if cstats:
+        c = cstats[-1]
+        q = (c.get("quantiles") or {}).get("loss") or {}
+        spread = (q["p95"] - q["p5"]
+                  if _fin(q.get("p95")) is not None
+                  and _fin(q.get("p5")) is not None else None)
+        print(f"-- clients: {len(cstats)} records, coverage "
+              f"{c.get('coverage', 0) * 100:.1f}% "
+              f"({c.get('distinct_clients', '?')} seen), last loss "
+              f"p50 {q.get('p50')} spread(p95-p5) "
+              + (f"{spread:.4g}" if spread is not None else "n/a"))
+
+    als = by_kind(events, "alert")
+    if als:
+        worst = max(als, key=lambda e: ("info", "warn", "critical").index(
+            e.get("severity", "info")))
+        print(f"-- alerts: {len(als)} fired, worst "
+              f"[{worst.get('severity')}] {worst.get('rule')} at round "
+              f"{worst.get('round')} (run `teleview alerts` for the list)")
+
     summ = next(iter(by_kind(events, "summary")), None)
     if summ is None:
         print("-- NO summary footer: the run DIED before finishing")
@@ -210,6 +258,85 @@ def summarize(events: List[Dict[str, Any]], label: str = "") -> None:
               f"{summ['n_rounds']} rounds, {summ['wall_time_s']:.1f}s wall")
     for e in by_kind(events, "nan_abort"):
         print(f"   nan_abort at round {e['nan_round']}: {e['reason']}")
+
+
+# -------------------------------------------------------------------- alerts
+
+
+def alerts(events: List[Dict[str, Any]]) -> int:
+    """Postmortem triage: every alert in firing order, rule counts, the
+    nan_abort line. Exit 1 when anything critical (or an abort) fired —
+    scriptable as a health gate over a finished run's stream."""
+    als = by_kind(events, "alert")
+    aborts = by_kind(events, "nan_abort")
+    if not als and not aborts:
+        print("no alerts (and no nan_abort) in the stream")
+        return 0
+    counts: Dict[str, int] = {}
+    for e in als:
+        counts[str(e.get("rule"))] = counts.get(str(e.get("rule")), 0) + 1
+        z = _fin(e.get("zscore"))
+        print(f"   r{e.get('round', '?'):>6} [{e.get('severity', '?'):8s}] "
+              f"{e.get('rule', '?'):24s} {e.get('metric', '?')}"
+              f"={e.get('value')}"
+              + (f" z {z:+.1f}" if z is not None else "")
+              + f" action={e.get('action', '?')}")
+    for e in aborts:
+        print(f"   nan_abort at round {e['nan_round']}: {e['reason']}")
+    if counts:
+        print("-- rule counts: "
+              + " ".join(f"{k}x{v}" for k, v in sorted(counts.items())))
+    critical = (any(e.get("severity") == "critical" for e in als)
+                or bool(aborts))
+    print(f"-- {'CRITICAL' if critical else 'no critical alerts'}")
+    return 1 if critical else 0
+
+
+# ------------------------------------------------------------------- clients
+
+
+def clients(events: List[Dict[str, Any]]) -> int:
+    """Per-client population trends from the ``client_stats`` stream:
+    p50/p95/max of every stat first->last, the participation ledger
+    trajectory, and the most frequent argmax (round-maximum) clients."""
+    cstats = by_kind(events, "client_stats")
+    if not cstats:
+        print("no client_stats events (pre-PR-4 stream, or "
+              "--no_client_stats)")
+        return 0
+    first, last = cstats[0], cstats[-1]
+    print(f"== client population: {len(cstats)} records, "
+          f"{last.get('n_participants', '?')} participants/round")
+    print(f"-- coverage {first.get('coverage', 0) * 100:.1f}% -> "
+          f"{last.get('coverage', 0) * 100:.1f}% "
+          f"({last.get('distinct_clients', '?')} distinct); staleness "
+          f"p50 {last.get('staleness_p50')} max {last.get('staleness_max')}"
+          f"; samples/client p50 {last.get('counts_p50')} "
+          f"max {last.get('counts_max')}")
+    print("-- per-client stat quantiles (first -> last)")
+    for key in CLIENT_STAT_KEYS:
+        qf = (first.get("quantiles") or {}).get(key) or {}
+        ql = (last.get("quantiles") or {}).get(key) or {}
+        if _fin(ql.get("p50")) is None and _fin(qf.get("p50")) is None:
+            continue
+
+        def fmt(q):
+            vals = [q.get(f) for f in ("p50", "p95", "max")]
+            return "/".join(f"{v:.4g}" if _fin(v) is not None else "-"
+                            for v in vals)
+
+        print(f"   {key:18s} p50/p95/max {fmt(qf)} -> {fmt(ql)}")
+    owners: Dict[int, int] = {}
+    for e in cstats:
+        c = ((e.get("quantiles") or {}).get("loss") or {}).get(
+            "argmax_client")
+        if isinstance(c, int):
+            owners[c] = owners.get(c, 0) + 1
+    if owners:
+        top = sorted(owners.items(), key=lambda kv: -kv[1])[:5]
+        print("-- clients most often owning the round's max loss: "
+              + " ".join(f"#{c}x{n}" for c, n in top))
+    return 0
 
 
 # ------------------------------------------------------------------- timeline
@@ -356,6 +483,32 @@ def diff(a: List[Dict[str, Any]], b: List[Dict[str, Any]],
         elif la > 0 and lb > la * args.loss_ratio:
             problems.append(f"loss: final {la:.4f} -> {lb:.4f} "
                             f"(> {args.loss_ratio:.2f}x)")
+
+    def loss_spread(events):
+        cs = by_kind(events, "client_stats")
+        if not cs:
+            return None
+        q = (cs[-1].get("quantiles") or {}).get("loss") or {}
+        hi, lo = _fin(q.get("p95")), _fin(q.get("p5"))
+        return hi - lo if hi is not None and lo is not None else None
+
+    pa, pb = loss_spread(a), loss_spread(b)
+    if pa is not None and pb is not None and pa > 0 \
+            and pb > pa * args.client_spread_ratio:
+        problems.append(
+            f"client_stats: final loss spread (p95-p5) {pa:.4g} -> "
+            f"{pb:.4g} (> {args.client_spread_ratio:.2f}x — the client "
+            "population is diverging)")
+
+    def crit_alerts(events):
+        return [e for e in by_kind(events, "alert")
+                if e.get("severity") == "critical"]
+
+    na, nb = len(crit_alerts(a)), len(crit_alerts(b))
+    if nb > na + args.alert_slack:
+        problems.append(
+            f"alerts: critical count {na} -> {nb} (the monitor fired on "
+            "the candidate where the baseline stayed quiet)")
     for e in by_kind(b, "nan_abort"):
         if not by_kind(a, "nan_abort"):
             problems.append(f"new run aborted non-finite at round "
@@ -387,6 +540,19 @@ def main(argv=None) -> int:
                         "15%% slower per peak-FLOP fails)")
     d.add_argument("--starvation_rise", type=float, default=0.10,
                    help="max ABSOLUTE rise of the final input_wait_frac")
+    d.add_argument("--client_spread_ratio", type=float, default=2.0,
+                   help="max growth factor of the final per-client loss "
+                        "spread (p95-p5) — population divergence")
+    d.add_argument("--alert_slack", type=int, default=0,
+                   help="critical-alert count growth tolerated (default "
+                        "0: any new critical alert fails)")
+    al = sub.add_parser("alerts", help="postmortem alert triage "
+                                       "(exit 1 on critical)")
+    al.add_argument("path")
+    cl = sub.add_parser("clients",
+                        help="per-client population trends from the "
+                             "client_stats stream")
+    cl.add_argument("path")
     t = sub.add_parser("timeline",
                        help="render the span stream into a perfetto/"
                             "chrome-tracing trace.json")
@@ -397,6 +563,10 @@ def main(argv=None) -> int:
     if args.cmd == "summarize":
         summarize(load_events(args.path), label=args.path)
         return 0
+    if args.cmd == "alerts":
+        return alerts(load_events(args.path))
+    if args.cmd == "clients":
+        return clients(load_events(args.path))
     if args.cmd == "timeline":
         return timeline(load_events(args.path), args.out)
     if args.cmd == "diff":

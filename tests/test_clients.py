@@ -1,0 +1,321 @@
+"""Per-client population observability (telemetry/clients.py + the
+core/client.py stat outputs + the runtime threading): device-side
+quantile summaries against numpy references, DP clip-saturation
+visibility, the fused-path NaN contract, the zero-hot-path-cost gating
+(HLO identity under --no_telemetry), the participation ledger, the
+schema round-trip of the new ``client_stats`` event, and the teleview
+``clients`` view."""
+
+import json
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.core import FedRuntime
+from commefficient_tpu.telemetry import (RunTelemetry, validate_event,
+                                         validate_file)
+from commefficient_tpu.telemetry.clients import (CLIENT_GRAD_KEYS,
+                                                 CLIENT_STAT_KEYS,
+                                                 ParticipationLedger,
+                                                 client_stats_to_host,
+                                                 quantiles_ordered,
+                                                 summarize_per_client)
+
+W, B, D_IN, D_OUT = 4, 4, 6, 3
+D = D_IN * D_OUT
+
+
+def loss_fn(params, batch, mask):
+    pred = batch["x"] @ params["w"]
+    m = mask.astype(jnp.float32)
+    denom = jnp.maximum(m.sum(), 1.0)
+    err = ((pred - batch["y"]) ** 2).sum(axis=1)
+    loss = (err * m).sum() / denom
+    return loss, (loss,)
+
+
+def make_runtime(**kw):
+    cfg_kw = dict(mode="uncompressed", error_type="none",
+                  local_momentum=0.0, virtual_momentum=0.9,
+                  weight_decay=0.0, num_workers=W, local_batch_size=B,
+                  track_bytes=True, num_clients=8, num_results_train=2,
+                  num_results_val=2, k=5, num_rows=2, num_cols=32,
+                  exact_num_cols=True)
+    cfg_kw.update(kw)
+    params = {"w": jnp.asarray(
+        np.random.RandomState(0).randn(D_IN, D_OUT), jnp.float32)}
+    return FedRuntime(FedConfig(**cfg_kw), params, loss_fn, num_clients=8)
+
+
+def make_batch(seed=1):
+    rng = np.random.RandomState(seed)
+    batch = {"x": jnp.asarray(rng.randn(W, B, D_IN), jnp.float32),
+             "y": jnp.asarray(rng.randn(W, B, D_OUT), jnp.float32)}
+    return batch, jnp.ones((W, B), bool), jnp.arange(W, dtype=jnp.int32)
+
+
+def fetch(metrics, client_ids):
+    return client_stats_to_host(metrics["client_stats"], client_ids)
+
+
+# ------------------------------------------------- device-side quantiles
+
+
+def test_summarize_matches_numpy_reference():
+    rng = np.random.RandomState(3)
+    vals = {"a": rng.randn(16).astype(np.float32),
+            "b": rng.rand(16).astype(np.float32)}
+    n_valid = np.ones(16, np.float32)
+    out = jax.jit(lambda v, n: summarize_per_client(v, n))(
+        {k: jnp.asarray(v) for k, v in vals.items()},
+        jnp.asarray(n_valid))
+    for key, v in vals.items():
+        np.testing.assert_allclose(
+            np.asarray(out[key]["q"]),
+            np.percentile(v, [5, 25, 50, 75, 95]).astype(np.float32),
+            rtol=1e-5)
+        assert float(out[key]["max"]) == pytest.approx(float(v.max()))
+        assert float(out[key]["mean"]) == pytest.approx(float(v.mean()),
+                                                        rel=1e-5)
+        assert int(out[key]["argmax"]) == int(v.argmax())
+
+
+def test_summarize_masks_invalid_and_nan_slots():
+    vals = {"a": jnp.asarray([1.0, 100.0, 2.0, jnp.nan])}
+    n_valid = jnp.asarray([1.0, 0.0, 1.0, 1.0])   # slot 1 fully padded
+    out = summarize_per_client(vals, n_valid)
+    # the padded slot's 100.0 and the NaN slot are both excluded
+    assert float(out["a"]["max"]) == pytest.approx(2.0)
+    assert int(out["a"]["argmax"]) == 2
+    host = client_stats_to_host({"a": out["a"]}, np.array([7, 8, 9, 10]))
+    assert host["a"]["argmax_client"] == 9
+    assert quantiles_ordered(host["a"])
+
+
+def test_all_nan_stat_serializes_null():
+    out = summarize_per_client({"a": jnp.full((4,), jnp.nan)},
+                               jnp.ones((4,)))
+    host = client_stats_to_host({"a": out["a"]}, np.arange(4))
+    assert all(host["a"][f] is None
+               for f in ("p5", "p50", "p95", "max", "mean"))
+    assert host["a"]["argmax_client"] is None
+
+
+# ----------------------------------------------------- runtime threading
+
+
+def test_round_client_stats_match_per_client_results():
+    """The vmap path: loss quantiles must be exactly the quantiles of
+    the per-client results vector the metrics already carry, and the
+    grad/tx stats must be finite and ordered."""
+    rt = make_runtime(fused_clients=False)
+    assert not rt._fused and rt._client_grad_stats
+    batch, mask, ids = make_batch()
+    ids = jnp.asarray([5, 2, 7, 0], jnp.int32)   # non-trivial id mapping
+    state, metrics = rt.round(rt.init_state(), ids, batch, mask, 0.05)
+    host = fetch(metrics, ids)
+    assert set(host) == set(CLIENT_STAT_KEYS)
+    losses = np.asarray(metrics["results"][0])
+    np.testing.assert_allclose(
+        [host["loss"]["p5"], host["loss"]["p50"], host["loss"]["p95"]],
+        np.percentile(losses, [5, 50, 95]), rtol=1e-5)
+    assert host["loss"]["argmax_client"] == int(
+        np.asarray(ids)[losses.argmax()])
+    for key in ("grad_norm_pre", "grad_norm_post", "tx_norm",
+                "upload_bytes", "download_bytes"):
+        assert host[key]["p50"] is not None, key
+        assert quantiles_ordered(host[key]), (key, host[key])
+    # uncompressed, no clip configured: saturation is NaN, not 0
+    assert host["clip_frac"]["mean"] is None
+    assert host["upload_bytes"]["p50"] == pytest.approx(4.0 * D)
+    # round 1 downloads are 0 (nothing updated yet); after round 1's
+    # dense update touched every coordinate, round 2's participants
+    # each download the full vector
+    assert host["download_bytes"]["max"] == 0.0
+    state, metrics = rt.round(state, ids, batch, mask, 0.05)
+    host2 = fetch(metrics, ids)
+    assert host2["download_bytes"]["p50"] == pytest.approx(4.0 * D)
+
+
+def test_fused_path_keeps_loss_stats_drops_grad_stats():
+    """The fused fast path never materializes per-client gradients —
+    its grad-stat quantiles are NaN (null), never fake zeros, while the
+    loss/bytes population stats stay live."""
+    rt = make_runtime(mode="sketch", error_type="virtual")
+    assert rt._fused and rt._client_stats
+    batch, mask, ids = make_batch()
+    state, metrics = rt.round(rt.init_state(), ids, batch, mask, 0.05)
+    host = fetch(metrics, ids)
+    assert host["loss"]["p50"] is not None
+    for key in CLIENT_GRAD_KEYS:
+        assert host[key]["p50"] is None, key
+        assert host[key]["mean"] is None, key
+
+
+def test_dp_clip_saturation_visible():
+    """A DP clip that binds for every client must read clip_frac mean
+    1.0 with grad_norm_post == l2_norm_clip; a clip far above the
+    gradient scale must read 0.0."""
+    batch, mask, ids = make_batch()
+    tight = make_runtime(do_dp=True, l2_norm_clip=1e-3,
+                         noise_multiplier=0.0)
+    _, metrics = tight.round(tight.init_state(), ids, batch, mask, 0.05)
+    host = fetch(metrics, ids)
+    assert host["clip_frac"]["mean"] == pytest.approx(1.0)
+    assert host["grad_norm_post"]["max"] == pytest.approx(1e-3, rel=1e-3)
+    assert host["grad_norm_pre"]["p50"] > 1e-2
+    loose = make_runtime(do_dp=True, l2_norm_clip=1e6,
+                         noise_multiplier=0.0)
+    _, metrics = loose.round(loose.init_state(), ids, batch, mask, 0.05)
+    host = fetch(metrics, ids)
+    assert host["clip_frac"]["mean"] == pytest.approx(0.0)
+
+
+def test_fedavg_tx_norm_only():
+    rt = make_runtime(mode="fedavg", local_batch_size=-1,
+                      max_client_batch=B, local_momentum=0.0)
+    batch, mask, ids = make_batch()
+    _, metrics = rt.round(rt.init_state(), ids, batch, mask, 0.05)
+    host = fetch(metrics, ids)
+    assert host["tx_norm"]["p50"] is not None
+    assert host["grad_norm_pre"]["p50"] is None
+    assert host["loss"]["p50"] is not None
+
+
+# --------------------------------------------------- zero-hot-path cost
+
+
+def test_no_client_stats_flag_drops_them():
+    rt = make_runtime(client_stats=False)
+    batch, mask, ids = make_batch()
+    _, metrics = rt.round(rt.init_state(), ids, batch, mask, 0.05)
+    assert metrics["client_stats"] is None
+
+
+def test_no_telemetry_drops_client_stats_too():
+    rt = make_runtime(telemetry=False)
+    assert not rt._client_stats
+    batch, mask, ids = make_batch()
+    _, metrics = rt.round(rt.init_state(), ids, batch, mask, 0.05)
+    assert metrics["client_stats"] is None
+
+
+def test_no_telemetry_compiles_stats_out_identically():
+    """--no_telemetry must leave the round's HLO byte-identical to a
+    round that never had the per-client machinery — the no-op tracer
+    identity argument, applied to the compiled graph."""
+    rt_off = make_runtime(telemetry=False, fused_clients=False)
+    rt_base = make_runtime(signals=False, client_stats=False,
+                           fused_clients=False)
+    batch, mask, ids = make_batch()
+    args = (rt_off.init_state(), ids, batch, mask,
+            jnp.asarray(0.05, jnp.float32), None)
+    hlo_off = rt_off._round.lower(*args).as_text()
+    hlo_base = rt_base._round.lower(*args).as_text()
+    assert hlo_off == hlo_base
+
+
+# ------------------------------------------------- participation ledger
+
+
+def test_participation_ledger_counts_coverage_staleness():
+    led = ParticipationLedger(8)
+    assert led.snapshot(0)["coverage"] == 0.0
+    led.observe(1, [0, 1, 2, 3], [4, 4, 2, 4])
+    led.observe(2, [0, 1, 4, 5], [4, 4, 4, 4])
+    snap = led.snapshot(4)
+    assert snap["distinct_clients"] == 6
+    assert snap["coverage"] == pytest.approx(6 / 8)
+    # clients 0/1 saw 8 samples, 2/3 saw 2/4, 4/5 saw 4
+    assert snap["counts_max"] == 8.0
+    # last rounds: 0,1,4,5 -> 2 (stale 2); 2,3 -> 1 (stale 3)
+    assert snap["staleness_max"] == 3.0
+    assert snap["staleness_p50"] == 2.0
+    ev = {"event": "client_stats", "t": 0.0, "seq": 0, "round": 4,
+          "n_participants": 4, "quantiles": {}, **snap}
+    assert validate_event(ev) == []
+
+
+# ------------------------------------------------- schema + event wiring
+
+
+def test_client_stats_event_roundtrip(tmp_path):
+    rt = make_runtime(fused_clients=False)
+    tel = RunTelemetry(str(tmp_path), "test", cfg=rt.cfg)
+    batch, mask, ids = make_batch()
+    _, metrics = rt.round(rt.init_state(), ids, batch, mask, 0.05)
+    led = ParticipationLedger(8)
+    led.observe(1, np.asarray(ids), np.asarray(mask).sum(axis=1))
+    tel.client_stats_event(rnd=1, n_participants=W,
+                           quantiles=fetch(metrics, ids),
+                           participation=led.snapshot(1))
+    tel.write_summary(aborted=False, n_rounds=1)
+    tel.close()
+    assert validate_file(tel.path) == []
+    events = [json.loads(line) for line in open(tel.path)]
+    cs = [e for e in events if e["event"] == "client_stats"]
+    assert len(cs) == 1
+    assert cs[0]["coverage"] == pytest.approx(0.5)
+    assert quantiles_ordered(cs[0]["quantiles"]["loss"])
+
+
+# ---------------------------------------------------------- teleview
+
+
+def _teleview():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "teleview", os.path.join(os.path.dirname(__file__), os.pardir,
+                                 "scripts", "teleview.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_teleview_fallback_client_keys_match_package():
+    src = open(os.path.join(os.path.dirname(__file__), os.pardir,
+                            "scripts", "teleview.py")).read()
+    block = re.search(r"CLIENT_STAT_KEYS = \((.*?)\)", src, re.S).group(1)
+    assert tuple(re.findall(r'"([a-z_0-9]+)"', block)) == CLIENT_STAT_KEYS
+
+
+def test_teleview_clients_view(tmp_path, capsys):
+    rt = make_runtime(fused_clients=False)
+    tel = RunTelemetry(str(tmp_path), "test", cfg=rt.cfg)
+    batch, mask, ids = make_batch()
+    state = rt.init_state()
+    led = ParticipationLedger(8)
+    for rnd in (1, 2):
+        state, metrics = rt.round(state, ids, batch, mask, 0.05)
+        led.observe(rnd, np.asarray(ids), np.asarray(mask).sum(axis=1))
+        tel.client_stats_event(rnd=rnd, n_participants=W,
+                               quantiles=fetch(metrics, ids),
+                               participation=led.snapshot(rnd))
+    tel.close()
+    tv = _teleview()
+    assert tv.main(["clients", tel.path]) == 0
+    out = capsys.readouterr().out
+    assert "coverage" in out and "loss" in out
+    assert "grad_norm_pre" in out
+    # an empty stream (pre-PR-4 vintage) is a note, not an error
+    empty = tmp_path / "old" / "telemetry.jsonl"
+    os.makedirs(empty.parent, exist_ok=True)
+    empty.write_text('{"event": "manifest", "t": 0, "seq": 0}\n')
+    assert tv.main(["clients", str(empty)]) == 0
+
+
+def test_teleview_truncated_trailing_line(tmp_path, capsys):
+    """A crashed writer's stream ends mid-line: teleview must read the
+    intact prefix and only note the truncation, never raise."""
+    p = tmp_path / "telemetry.jsonl"
+    p.write_text('{"event": "manifest", "t": 0, "seq": 0, "schema": 3}\n'
+                 '{"event": "round", "t": 1, "seq": 1, "round": 1, "los')
+    tv = _teleview()
+    events = tv.load_events(str(p))
+    assert [e["event"] for e in events] == ["manifest"]
+    assert "truncated" in capsys.readouterr().err

