@@ -40,7 +40,8 @@ NOMINAL_SINGLE_GPU_IMG_PER_SEC = 2000.0
 
 
 def run_cifar(result: dict, W: int = 8, B: int = 64,
-              n_rounds: int = 20, telemetry=None, profiler=None) -> None:
+              n_rounds: int = 20, telemetry=None, profiler=None,
+              compile_cache=None) -> None:
     """Fill ``result`` in place so partial progress survives a crash.
 
     Default (W=8, B=64) is the flagship-parity round shape — 512
@@ -70,7 +71,11 @@ def run_cifar(result: dict, W: int = 8, B: int = 64,
         approx_topk=True,
     )
     # persistent compile cache: retried compiles and the cost-analysis
-    # lower+compile after the timing loop become near-free
+    # lower+compile after the timing loop become near-free; --compile_cache
+    # overrides the default per-machine directory (empty string = disable,
+    # for true cold-start warmup_s measurements; None = keep the default)
+    if compile_cache is not None:
+        cfg = cfg.replace(compilation_cache_dir=compile_cache)
     enable_compilation_cache(cfg)
 
     model = models.ResNet9(num_classes=10)
@@ -107,9 +112,16 @@ def run_cifar(result: dict, W: int = 8, B: int = 64,
     result["value"] = round(ips, 1)
     result["vs_baseline"] = round(ips / NOMINAL_SINGLE_GPU_IMG_PER_SEC, 3)
     result["timed_rounds"] = n_rounds
+    # compile+warmup wall seconds BEFORE the timed window — the number
+    # --compile_cache exists to shrink (cold ~77 s for this driver run,
+    # warm-start target < 10 s); tracked in the BENCH trajectory
+    result["warmup_s"] = phases.pop("warmup_s", None)
     # where the timed wall clock went: dispatch (async round calls),
     # device_wait (trailing completion barrier), host (loop remainder)
     result["phase_split"] = phases
+    # headline starvation fraction, gateable by `teleview diff
+    # --input_wait_rise` on the bench trajectory (not just run streams)
+    result["input_wait_frac"] = round(phases["host_s"] / dt, 6)
 
     # MFU numerator = MODEL FLOPs (the ResNet-9 fwd+bwd for the round's
     # W*B images, from XLA's cost analysis of the bare value_and_grad — no
@@ -175,6 +187,12 @@ def add_bench_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--profile_rounds", default="2:4",
                     help="1-based inclusive timed-round window for the "
                          "trace, START:STOP")
+    ap.add_argument("--compile_cache", default=None,
+                    help="persistent XLA compile cache DIR (unset: the "
+                         "config default, ~/.cache/commefficient_tpu_xla; "
+                         "pass an empty string to DISABLE and measure a "
+                         "true cold start); warm starts skip the cold "
+                         "compile tax recorded as warmup_s in the JSON")
 
 
 def main(argv=None):
@@ -190,7 +208,8 @@ def main(argv=None):
         "mfu": None,
     }
     try:
-        run_cifar(result, telemetry=telemetry, profiler=profiler)
+        run_cifar(result, telemetry=telemetry, profiler=profiler,
+                  compile_cache=args.compile_cache)
     except Exception as e:
         log(traceback.format_exc())
         result["error"] = f"{type(e).__name__}: {e}"
@@ -210,7 +229,8 @@ def main(argv=None):
         sat = {"metric": "cifar10_sketch_round_throughput_saturated",
                "value": None, "unit": "images/sec", "vs_baseline": None,
                "mfu": None, "round_images": 32 * 512}
-        run_cifar(sat, W=32, B=512, n_rounds=10, telemetry=telemetry)
+        run_cifar(sat, W=32, B=512, n_rounds=10, telemetry=telemetry,
+                  compile_cache=args.compile_cache)
         result["cifar_saturated"] = sat
         log("saturated:", json.dumps(sat))
     except Exception as e:
@@ -223,7 +243,8 @@ def main(argv=None):
     # chip, and vice versa)
     try:
         import bench_gpt2
-        result["gpt2"] = bench_gpt2.run(telemetry=telemetry)
+        result["gpt2"] = bench_gpt2.run(telemetry=telemetry,
+                                        compile_cache=args.compile_cache)
     except Exception as e:
         log(traceback.format_exc())
         log(f"WARNING: GPT-2 bench failed ({e})")

@@ -86,8 +86,17 @@ def with_retries(fn, *, desc: str, tries: int = 4, base_delay: float = 5.0):
 
 
 def timed_rounds(runtime, round_args, *, warmup, rounds, desc: str,
-                 profiler=None):
+                 profiler=None, round_args_fn=None):
     """Donation-safe, retry-wrapped warmup + timing of federated rounds.
+
+    ``round_args_fn(i)`` (optional) builds round ``i``'s args INSIDE the
+    warmup/timed loops instead of reusing the pre-staged ``round_args``
+    (pass None for it then) — for benches whose per-round input staging
+    is part of what they measure (a per-round host->device batch copy vs
+    a device-store gather, scripts/bench_imagenet.py). It must be
+    deterministic in ``i`` (retried attempts replay the same rounds);
+    its wall time lands in the ``host_s`` phase, i.e. the bench's
+    ``input_wait_frac``.
 
     ``profiler`` (telemetry.ProfilerWindow) places a jax trace over the
     TIMED rounds, numbered 1..rounds — the warmup (and its compile) stays
@@ -114,8 +123,12 @@ def timed_rounds(runtime, round_args, *, warmup, rounds, desc: str,
     the async round calls), ``device_wait_s`` (the trailing completion
     barrier) and ``host_s`` (everything else — loop overhead and, when
     profiling, the per-round syncs; the batch is pre-staged here so
-    there is no data-fetch phase). All clocks are ``perf_counter`` — an
-    NTP step during a long timing loop must not skew the headline.
+    there is no data-fetch phase), plus ``warmup_s`` — the compile +
+    warmup wall seconds BEFORE the timed window (the cold-vs-warm-start
+    number the ``--compile_cache`` flag exists to shrink; callers lift
+    it into the BENCH json so the trajectory tracks it). All clocks are
+    ``perf_counter`` — an NTP step during a long timing loop must not
+    skew the headline.
     """
     import jax
     import jax.numpy as jnp
@@ -123,15 +136,18 @@ def timed_rounds(runtime, round_args, *, warmup, rounds, desc: str,
 
     def warm():
         s = runtime.init_state()
-        for _ in range(warmup):
-            s, m = runtime.round(s, *round_args)
+        for w in range(warmup):
+            args = (round_args if round_args_fn is None
+                    else round_args_fn(w))
+            s, m = runtime.round(s, *args)
         float(s.ps_weights[0])
         return s
 
     log("compiling + warmup...")
     t0 = time.perf_counter()
     state = with_retries(warm, desc=f"{desc} compile+warmup")
-    log(f"warmup done in {time.perf_counter() - t0:.1f}s")
+    warmup_s = time.perf_counter() - t0
+    log(f"warmup done in {warmup_s:.1f}s")
     host_state = jax.tree.map(np.asarray, state)
     jax.tree.map(lambda x: x.delete(), state)
 
@@ -145,8 +161,12 @@ def timed_rounds(runtime, round_args, *, warmup, rounds, desc: str,
             for i in range(rounds):
                 if profiler is not None:
                     profiler.maybe_start(i + 1)
+                # input staging OUTSIDE the dispatch timer: a per-round
+                # batch build/copy shows up as host_s (input wait)
+                args = (round_args if round_args_fn is None
+                        else round_args_fn(i))
                 td = time.perf_counter()
-                s, m = runtime.round(s, *round_args)
+                s, m = runtime.round(s, *args)
                 dispatch_s += time.perf_counter() - td
                 if profiler is not None:
                     profiler.maybe_stop(
@@ -169,4 +189,9 @@ def timed_rounds(runtime, round_args, *, warmup, rounds, desc: str,
                   "device_wait_s": round(t2 - t1, 6)}
         return t2 - t0, m, phases
 
-    return with_retries(timed, desc=f"{desc} timing loop")
+    dt, m, phases = with_retries(timed, desc=f"{desc} timing loop")
+    # warmup is OUTSIDE the timed wall (the fractions below stay fractions
+    # of the timed window); carried so the BENCH json can track the
+    # cold/warm compile tax alongside the throughput it does not affect
+    phases["warmup_s"] = round(warmup_s, 3)
+    return dt, m, phases

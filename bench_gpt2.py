@@ -47,14 +47,23 @@ from commefficient_tpu.models.gpt2 import gpt2_model_flops  # noqa: F401
 NOMINAL_SINGLE_GPU_TOK_PER_SEC = 4500.0
 
 
-def run(remat: bool = True, telemetry=None, profiler=None) -> dict:
+def run(remat: bool = True, telemetry=None, profiler=None, *,
+        remat_policy: str = "", microbatch: int = 8, lm_chunk: int = 128,
+        n_rounds: int = 8, compile_cache=None) -> dict:
     """Build, warm up and time the GPT-2 round; returns the result dict.
 
     ``remat=True`` is the shipping configuration. remat=False spends the
     HBM the fused-clients path freed on saved activations instead of
     backward recompute — measured SLOWER (69.3k vs 76.5k tok/s pre-pallas
     -encode: the saved-activation HBM traffic costs more than the
-    recompute FLOPs); kept parameterized so the trade stays measurable."""
+    recompute FLOPs); kept parameterized so the trade stays measurable.
+
+    ``remat_policy``/``microbatch``/``lm_chunk`` parameterize the MFU
+    sweep (scripts/gpt2_mfu_sweep.py): selective-remat policies between
+    full remat and none, the microbatch/HBM trade, and the chunked-CE
+    granularity — the three knobs runs/BREAKDOWN_gpt2.md names between
+    the measured 33% and the 40% target. ``microbatch`` must divide the
+    8-dialogue client batch."""
     import jax
     import jax.numpy as jnp
 
@@ -64,7 +73,7 @@ def run(remat: bool = True, telemetry=None, profiler=None) -> dict:
     from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
 
     log("devices:", jax.devices())
-    gcfg = GPT2Config(remat=remat)
+    gcfg = GPT2Config(remat=remat, remat_policy=remat_policy)
     model = GPT2DoubleHeads(gcfg)
     W, B, NC, S = 8, 8, 2, 256
     rng = np.random.RandomState(0)
@@ -84,10 +93,13 @@ def run(remat: bool = True, telemetry=None, profiler=None) -> dict:
 
     cfg = FedConfig(mode="sketch", error_type="virtual", local_momentum=0.0,
                     virtual_momentum=0.9, weight_decay=0.0,
-                    num_workers=W, local_batch_size=B, microbatch_size=8,
+                    num_workers=W, local_batch_size=B,
+                    microbatch_size=microbatch,
                     k=50_000, num_rows=5, num_cols=524_288, num_blocks=20,
                     num_clients=100, track_bytes=False, approx_topk=True,
-                    num_results_train=2, lm_chunk=128)
+                    num_results_train=2, lm_chunk=lm_chunk)
+    if compile_cache is not None:  # "" = disable (true cold start)
+        cfg = cfg.replace(compilation_cache_dir=compile_cache)
     enable_compilation_cache(cfg)
     runtime = FedRuntime(cfg, params,
                          make_gpt2_train_loss(model, lm_chunk=cfg.lm_chunk),
@@ -100,10 +112,10 @@ def run(remat: bool = True, telemetry=None, profiler=None) -> dict:
     mask = jnp.ones((W, B), bool)
     ids = jnp.arange(W, dtype=jnp.int32)
 
-    n_rounds = 8
     dt, metrics, phases = timed_rounds(runtime, (ids, batch, mask, 0.1),
                                        warmup=1, rounds=n_rounds, desc="gpt2",
                                        profiler=profiler)
+    warmup_s = phases.pop("warmup_s", None)
 
     toks = n_rounds * W * B * NC * S
     tps = toks / dt
@@ -124,7 +136,12 @@ def run(remat: bool = True, telemetry=None, profiler=None) -> dict:
         "mfu": round(mfu, 4) if np.isfinite(mfu) else None,
         "tokens_per_round": W * B * NC * S,
         "timed_rounds": n_rounds,
+        "warmup_s": warmup_s,
         "phase_split": phases,
+        "input_wait_frac": round(phases["host_s"] / dt, 6),
+        # the sweep knobs this arm ran under (scripts/gpt2_mfu_sweep.py)
+        "config": {"remat": remat, "remat_policy": remat_policy,
+                   "microbatch": microbatch, "lm_chunk": lm_chunk},
     }
     if telemetry is not None:
         from commefficient_tpu.telemetry.utilization import emit_from_totals
@@ -146,7 +163,8 @@ def main(argv=None):
     add_bench_args(ap)
     args = ap.parse_args(argv)
     telemetry, profiler = make_bench_telemetry(args, "bench_gpt2")
-    result = run(telemetry=telemetry, profiler=profiler)
+    result = run(telemetry=telemetry, profiler=profiler,
+                 compile_cache=args.compile_cache)
     if telemetry is not None:
         telemetry.write_summary(aborted=False,
                                 n_rounds=result["timed_rounds"],

@@ -324,8 +324,18 @@ class FedConfig:
     # runs/gpt2_conv/README.md (subtract dose-response)
     strict_regimes: bool = False
     # persistent XLA compilation cache directory: the GPT-2-scale federated
-    # round compiles in ~10 min cold — pay it once per machine, not per run
+    # round compiles in ~10 min cold — pay it once per machine, not per run.
+    # Flag spelling: --compile_cache (alias --compilation_cache_dir)
     compilation_cache_dir: str = "~/.cache/commefficient_tpu_xla"
+    # round input pipeline (core/pipeline.py): prefetch round t+1's client
+    # indices + batch on a background thread while round t executes.
+    # Bit-identical losses to the inline path (dryrun-asserted — all
+    # randomness is keyed by the round index); --no_pipeline reverts to
+    # the fully synchronous fetch->dispatch loop
+    pipeline: bool = True
+    # how many rounds the prefetcher runs ahead (queue bound). 2 =
+    # double-buffered: one batch in flight to the device, one staged
+    prefetch_depth: int = 2
     # rematerialize transformer blocks on backward (memory/FLOPs trade)
     do_remat: bool = False
     # selective-remat policy (jax.checkpoint_policies attribute name, e.g.
@@ -395,6 +405,7 @@ class FedConfig:
         assert self.alert_action in ALERT_ACTIONS, self.alert_action
         assert self.alert_window >= 4, self.alert_window
         assert self.alert_zscore > 0, self.alert_zscore
+        assert self.prefetch_depth >= 1, self.prefetch_depth
         if self.profile_dir:
             # a bad window spec must fail at startup, not at round START
             from commefficient_tpu.telemetry.profiling import \
@@ -487,13 +498,19 @@ def enable_compilation_cache(cfg: "FedConfig") -> None:
     """Persistent XLA compile cache (the GPT-2-scale round compiles in ~10
     minutes cold; cache it per machine). Best-effort: unavailable backends
     or read-only filesystems silently skip."""
-    if not cfg.compilation_cache_dir:
+    enable_compilation_cache_dir(cfg.compilation_cache_dir)
+
+
+def enable_compilation_cache_dir(cache_dir: str) -> None:
+    """Path-form of :func:`enable_compilation_cache` for callers without a
+    FedConfig in hand (the bench scripts' ``--compile_cache`` flag)."""
+    if not cache_dir:
         return
     try:
         import os
 
         import jax
-        path = os.path.expanduser(cfg.compilation_cache_dir)
+        path = os.path.expanduser(cache_dir)
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
@@ -666,9 +683,19 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                    help="fail at startup (instead of warning) on "
                         "configurations measured divergent in round 5 "
                         "(see core/server.py check_regime_health)")
-    p.add_argument("--compilation_cache_dir", type=str,
+    p.add_argument("--compile_cache", "--compilation_cache_dir",
+                   dest="compilation_cache_dir", type=str,
                    default="~/.cache/commefficient_tpu_xla",
-                   help="persistent XLA compile cache; empty disables")
+                   help="persistent XLA compile cache DIR; empty disables "
+                        "(warm starts skip the multi-minute round compile)")
+    p.add_argument("--no_pipeline", dest="pipeline", action="store_false",
+                   default=True,
+                   help="disable the round input pipeline (inline "
+                        "fetch->dispatch; bit-identical losses, no "
+                        "prefetch overlap)")
+    p.add_argument("--prefetch_depth", type=int, default=2,
+                   help="rounds the input pipeline prefetches ahead "
+                        "(2 = double-buffered)")
     p.add_argument("--remat", action="store_true", dest="do_remat")
     p.add_argument("--remat_policy", type=str, default="")
     p.add_argument("--lm_chunk", type=int, default=0)

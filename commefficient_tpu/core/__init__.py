@@ -1,5 +1,7 @@
 from commefficient_tpu.core.server import server_update, validate_mode_combo
 from commefficient_tpu.core.state import FedState
 from commefficient_tpu.core.runtime import FedRuntime
+from commefficient_tpu.core.pipeline import RoundInput, RoundPipeline
 
-__all__ = ["server_update", "validate_mode_combo", "FedState", "FedRuntime"]
+__all__ = ["server_update", "validate_mode_combo", "FedState", "FedRuntime",
+           "RoundInput", "RoundPipeline"]

@@ -25,7 +25,7 @@ import numpy as np
 from commefficient_tpu import models
 from commefficient_tpu.config import (FedConfig, enable_compilation_cache,
                                       num_classes_of_dataset, parse_args)
-from commefficient_tpu.core import FedRuntime
+from commefficient_tpu.core import FedRuntime, RoundPipeline
 from commefficient_tpu.data import (
     FedSampler,
     ValSampler,
@@ -341,35 +341,48 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
     rounds_run = 0
     summary = None
 
+    # round input fetch, shared by the pipelined and inline paths
+    # (core/pipeline.py): all randomness keys off the GLOBAL round index,
+    # so prefetching ahead cannot change what trains
+    def fetch_round(rnd, g_round: int):
+        if train_store is not None:
+            return train_store.round_batch(
+                rnd.idx, jax.random.fold_in(data_key, g_round))
+        b = train_ds.gather(rnd.idx)
+        return {k: jnp.asarray(v) for k, v in b.items()}
+
     if cfg.eval_before_start:
         test_loss, test_acc = run_validation(runtime, state, val_ds, cfg,
                                              val_store=val_store)
         print(f"Test acc at epoch 0: {test_acc:0.4f}")
 
+    pipe = None
     try:
         for epoch in range(start_epoch, math.ceil(cfg.num_epochs)):
             epoch_fraction = (cfg.num_epochs - epoch
                               if epoch == math.ceil(cfg.num_epochs) - 1 else 1.0)
             ep_sums = None   # device accumulator: [loss*w, acc*w, w, down, up]
-            for i, rnd in enumerate(epoch_sampler(epoch)):
-                # fractional final epoch (reference cv_train.py:194-196)
-                if i >= spe * epoch_fraction:
-                    break
-                global_round += 1
+            # round input pipeline: the prefetcher owns the fractional-
+            # epoch cap (reference cv_train.py:194-196) and the global
+            # round numbering; with --no_pipeline it degrades to the same
+            # fetch inline (bit-identical rounds, see core/pipeline.py)
+            pipe = RoundPipeline(
+                epoch_sampler(epoch), fetch_round,
+                start_round=global_round,
+                max_rounds=(1 if cfg.do_test
+                            else int(math.ceil(spe * epoch_fraction))),
+                depth=cfg.prefetch_depth, enabled=cfg.pipeline)
+            for item in pipe:
+                rnd, batch = item.rnd, item.batch
+                global_round = item.global_round
                 t_loop = time.perf_counter()
+                # host_s = what the loop WAITED for this round's input
+                # (inline: the fetch itself; pipelined: the queue wait —
+                # the prefetch overlap is exactly host_s shrinking)
+                host_s = item.wait_s
                 lr = schedule(global_round / spe)
                 lr_arr = (jnp.asarray(lr, jnp.float32) if lr_mult is None
                           else lr * lr_mult)
-                with tracing.span("data_fetch"):
-                    if train_store is not None:
-                        batch = train_store.round_batch(
-                            rnd.idx,
-                            jax.random.fold_in(data_key, global_round))
-                    else:
-                        batch = train_ds.gather(rnd.idx)
-                        batch = {k: jnp.asarray(v)
-                                 for k, v in batch.items()}
-                t_host = time.perf_counter()
                 prof.maybe_start(global_round)
                 state, metrics = runtime.round(
                     state, rnd.client_ids, batch, rnd.mask, lr_arr)
@@ -392,8 +405,8 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                     # device_s is only measured on synced (record) rounds;
                     # the tracker treats None as "not measured", not zero
                     util.observe_round(
-                        host_s=t_host - t_loop,
-                        dispatch_s=t_dispatch - t_host,
+                        host_s=host_s,
+                        dispatch_s=t_dispatch - t_loop,
                         device_s=(t_device - t_dispatch) if record
                         else None)
                 # ---- untimed tail: every phase boundary above is already
@@ -429,8 +442,8 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                             n_valid=float(nv.sum()),
                             download_bytes=down_total,
                             upload_bytes=up_total,
-                            host_s=t_host - t_loop,
-                            dispatch_s=t_dispatch - t_host,
+                            host_s=host_s,
+                            dispatch_s=t_dispatch - t_loop,
                             device_s=t_device - t_dispatch)
                         if metrics.get("signals"):
                             # compression-signal health, same cadence / same
@@ -506,6 +519,13 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                 if cfg.do_test:
                     break
 
+            # reclaim the prefetch thread at the epoch boundary. In the
+            # normal case every round was consumed; on the early-exit
+            # paths (--test) unconsumed prefetched batches are dropped —
+            # a stateful host-transform RNG may have advanced for them,
+            # which is fine only because nothing trains on this dataset
+            # stream afterwards (see RoundPipeline.close)
+            pipe.close()
             if util is not None:
                 # close the round window at the epoch boundary: the
                 # validation sweep below must not dilute the round MFU
@@ -628,6 +648,11 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
         prof.abort()
         raise
     finally:
+        # reclaim the prefetch thread however the loop ends (abort
+        # returns, NaN aborts, exceptions) — close() is idempotent, so
+        # the epoch-boundary close above makes this a no-op normally
+        if pipe is not None:
+            pipe.close()
         # release the process-global span tracer however the loop ends
         # (the tail below only DRAINS the local tracer object, which
         # stays valid after uninstall)

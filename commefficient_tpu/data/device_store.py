@@ -17,9 +17,14 @@ random crop + horizontal flip + per-channel normalize, the cifar10_fast
 recipe) but draws its randomness from a jax PRNG key, so augmentation draws differ from the host pipeline — irrelevant for
 training quality, and the eval path (normalize only) is exactly equal.
 
-Scope: image-classification stores (CIFAR/EMNIST-style uint8 or float
-images + int targets) and identity stores (already-tokenized persona int
-arrays). Anything else falls back to the host pipeline.
+Scope: image-classification stores (CIFAR/EMNIST/ImageNet-style uint8 or
+float images + int targets) and identity stores (already-tokenized
+persona int arrays). Anything else falls back to the host pipeline.
+ImageNet 224^2 rides the same machinery with a flip+normalize train
+augment ("imagenet_train"): the uint8 store plus the fused on-device
+normalize removes the per-round host input copy whose lane-padded
+(C=3 -> 128) layout the round trace attributed 4.8-9.6 ms/round to
+(runs/BREAKDOWN_imagenet.md).
 """
 
 from __future__ import annotations
@@ -109,11 +114,26 @@ class DeviceStore:
     # the host stacks in data/transforms.py (CifarTrain / FemnistTrain)
     _SHIFT_CROP = {"cifar_train": (4, "reflect", True),
                    "emnist_train": (2, "edge", False)}
+    # flip-only kinds (no shift crop); mirrors ImagenetTrain — the store
+    # is pre-sized at prepare time, so train augmentation is a horizontal
+    # flip + normalize, all fused into the gather jit. The resident array
+    # stays uint8 (4x smaller than float32 at 224^2, and the round's
+    # input arrives as a device-produced value instead of a host copy —
+    # the lane-padded C=3->128 input transfer the ImageNet trace blamed,
+    # runs/BREAKDOWN_imagenet.md)
+    _FLIP_ONLY = ("imagenet_train",)
 
     def _transform_images(self, img: jax.Array, rng) -> jax.Array:
         x = img.astype(jnp.float32)
         if img.dtype == jnp.uint8:   # raw 0..255 bytes
             x = x / 255.0
+        if self.augment in self._FLIP_ONLY:
+            H, W, C = x.shape[-3:]
+            flat = x.reshape((-1, H, W, C))
+            do_flip = jax.random.bernoulli(rng, 0.5, (flat.shape[0],))
+            flat = jnp.where(do_flip[:, None, None, None],
+                             flat[:, :, ::-1, :], flat)
+            x = flat.reshape(x.shape)
         if self.augment in self._SHIFT_CROP:
             p, pad_mode, flip = self._SHIFT_CROP[self.augment]
             H, W, C = x.shape[-3:]
@@ -163,15 +183,19 @@ class DeviceStore:
 
 
 _AUGMENT_FOR = {
-    # dataset_name -> (train_augment, normalize-constant prefix)
-    # "host": the train augmentation has no device equivalent yet (the
-    # ImageNet 224 RandomResizedCrop needs per-image resampling) — train
-    # stays on the host pipeline while eval still benefits from the
-    # device path
+    # dataset_name -> (train_augment, normalize-constant prefix).
+    # ImageNet's host transform (ImagenetTrain) is flip + normalize on
+    # pre-sized crops — its device equivalent is "imagenet_train", so
+    # 224^2 train batches are gathered, flipped and normalized ON DEVICE
+    # from the uint8-resident store instead of streaming a float32 (and
+    # lane-padded, C=3->128) host copy every round. A real-size ImageNet
+    # (190 GB uint8) still exceeds max_bytes and falls back to the host
+    # pipeline, where the round pipeline (core/pipeline.py) hides the
+    # gather instead.
     "CIFAR10": ("cifar_train", "CIFAR10"),
     "CIFAR100": ("cifar_train", "CIFAR100"),
     "EMNIST": ("emnist_train", "FEMNIST"),
-    "ImageNet": ("host", "IMAGENET"),
+    "ImageNet": ("imagenet_train", "IMAGENET"),
     "PERSONA": (None, None),
 }
 

@@ -267,8 +267,15 @@ class FedDataset:
         through the global permutation first (reference fed_dataset.py:64-68).
         Accepts any index shape; output leaves have that leading shape.
         The host_gather span is the host data pipeline's wall time — on
-        runs without a DeviceStore this IS the input-wait phase the
-        utilization events report."""
+        runs without a DeviceStore this is the input cost the round
+        pipeline (core/pipeline.py) moves OFF the critical path.
+
+        Prefetch contract: the round pipeline calls this from its single
+        worker thread, one call per round in round order — exactly the
+        inline call sequence — so stateful host-transform RNGs (e.g.
+        CifarTrain's per-call draws) advance identically pipelined or
+        not. Never share one FedDataset between two concurrent
+        consumers; per-call determinism is sequential, not locked."""
         with tracing.span("host_gather"):
             return self._gather(flat_idx)
 

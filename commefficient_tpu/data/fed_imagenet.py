@@ -10,6 +10,15 @@ pass, not the per-round hot path. ``prepare_datasets`` walks a
 to ``image_size`` and packs per-client uint8 npy shards in the same
 client-file layout as FedCIFAR; ``synthetic=True`` generates a small stand-in
 tree. The per-round path is then identical to CIFAR: one vectorized gather.
+
+The prepared arrays stay **uint8 end to end**: when the set fits the
+device-store budget, the round batch is gathered, flipped and normalized
+ON DEVICE ("imagenet_train" augment, data/device_store.py) — no per-round
+float32 host input copy, which at 224^2 transferred with the C=3 channel
+lane-padded to 128 (~42x inflation, 4.8-9.6 ms/round in the committed
+trace, runs/BREAKDOWN_imagenet.md). Oversized sets fall back to the host
+gather, which the round pipeline (core/pipeline.py) overlaps with device
+execution instead.
 """
 
 from __future__ import annotations

@@ -5,6 +5,17 @@ uncompressed, virtual momentum, iid — SURVEY §6). Measures the full
 federated round (fused client gradients + reduce/server update) on one
 chip; prints one JSON line like the other benches.
 
+Input layout (the PR-5 fix): the batch is staged PER ROUND, inside the
+timed loop, the way a training run actually feeds the chip. Default
+``uint8_device``: each round gathers + flips + normalizes on device
+from the uint8-resident store ("imagenet_train", data/device_store.py)
+— no per-round float32 host input copy exists. ``--layout float_host``
+instead device_puts the full float32 batch every round — the old input
+path, whose lane-padded (C=3 -> 128, ~42x inflated) transfer the
+committed trace attributed 4.8-9.6 ms/round to
+(runs/BREAKDOWN_imagenet.md) — so the two arms A/B exactly the input
+fix, visible in ``input_wait_frac``/``host_s`` and the throughput.
+
 Kept OUT of the driver-run bench.py: a cold FixupResNet50@224 compile is
 minutes long and the driver artifact must never hang on it; run this
 standalone and the number is recorded in README.md. (Measured scaling
@@ -12,11 +23,13 @@ note: doubling the local batch to 128 lifts 2,812 -> 3,211 img/s /
 17.6% -> 20.0% MFU — the round is conv-efficiency-bound at 224x224,
 not batch-bound like the CIFAR flagship shape.)
 
-Usage: python scripts/bench_imagenet.py
+Usage: python scripts/bench_imagenet.py [--layout uint8_device]
+           [--telemetry_dir DIR] [--compile_cache DIR]
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -25,7 +38,19 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    from bench import add_bench_args, make_bench_telemetry
+    add_bench_args(ap)
+    ap.add_argument("--layout", choices=("uint8_device", "float_host"),
+                    default="uint8_device",
+                    help="per-round batch staging: uint8 device store "
+                         "with fused on-device normalize (default), or "
+                         "the old per-round float32 host->device copy")
+    ap.add_argument("--local_batch", type=int, default=64)
+    ap.add_argument("--rounds", type=int, default=10)
+    args = ap.parse_args(argv)
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -34,15 +59,20 @@ def main():
     from commefficient_tpu import models
     from commefficient_tpu.config import FedConfig, enable_compilation_cache
     from commefficient_tpu.core import FedRuntime
+    from commefficient_tpu.data import transforms as T
+    from commefficient_tpu.data.device_store import DeviceStore
     from commefficient_tpu.losses import make_cv_loss
 
+    telemetry, profiler = make_bench_telemetry(args, "bench_imagenet")
     log("devices:", jax.devices())
-    W, B, HW = 7, 64, 224
+    W, B, HW = 7, args.local_batch, 224
     cfg = FedConfig(mode="uncompressed", error_type="virtual",
                     local_momentum=0.0, virtual_momentum=0.9,
                     weight_decay=1e-4, num_workers=W, local_batch_size=B,
                     num_clients=7, do_iid=True, track_bytes=False,
                     num_results_train=2)
+    if args.compile_cache:
+        cfg = cfg.replace(compilation_cache_dir=args.compile_cache)
     enable_compilation_cache(cfg)
     model = models.FixupResNet50(num_classes=1000)
     params = model.init(jax.random.PRNGKey(0),
@@ -50,18 +80,46 @@ def main():
     loss_fn = make_cv_loss(model, "bfloat16")
     runtime = FedRuntime(cfg, params, loss_fn, num_clients=cfg.num_clients)
     log(f"grad size {runtime.cfg.grad_size}")
+    if telemetry is not None:
+        telemetry.instrument(runtime)
+        telemetry.memory_event("imagenet_init")
 
     rng = np.random.RandomState(0)
-    batch = {"image": jnp.asarray(rng.randn(W, B, HW, HW, 3), jnp.float32),
-             "target": jnp.asarray(rng.randint(0, 1000, (W, B)), jnp.int32)}
+    targets_dev = jnp.asarray(rng.randint(0, 1000, (W, B)), jnp.int32)
     mask = jnp.ones((W, B), bool)
     ids = jnp.arange(W, dtype=jnp.int32)
+    if args.layout == "uint8_device":
+        # the driver path: raw uint8 resident once; every round's batch
+        # is a DEVICE-produced value (gather + flip + normalize in one
+        # jit) — the float32 host input copy never exists
+        imgs_u8 = rng.randint(0, 255, (W * B, HW, HW, 3), dtype=np.uint8)
+        store = DeviceStore({"image": imgs_u8},
+                            augment="imagenet_train",
+                            mean=T.IMAGENET_MEAN, std=T.IMAGENET_STD)
+        log(f"uint8 device store: {store.nbytes / 2**20:.0f} MiB resident")
+        idx = np.arange(W * B).reshape(W, B)
+        key = jax.random.PRNGKey(1)
 
-    n_rounds = 10
+        def round_args_fn(i):
+            got = store.round_batch(idx, jax.random.fold_in(key, i))
+            return (ids, {"image": got["image"], "target": targets_dev},
+                    mask, 0.1)
+    else:
+        # the old input path: the full float32 batch crosses host->device
+        # EVERY round (the lane-padded C=3->128 copy in the trace)
+        host_imgs = rng.randn(W, B, HW, HW, 3).astype(np.float32)
+
+        def round_args_fn(i):
+            return (ids, {"image": jax.device_put(host_imgs),
+                          "target": targets_dev}, mask, 0.1)
+
+    n_rounds = args.rounds
     t0 = time.time()
-    dt, metrics, _phases = timed_rounds(runtime, (ids, batch, mask, 0.1),
-                                        warmup=2, rounds=n_rounds,
-                                        desc="imagenet")
+    dt, metrics, phases = timed_rounds(runtime, None,
+                                       warmup=2, rounds=n_rounds,
+                                       desc="imagenet", profiler=profiler,
+                                       round_args_fn=round_args_fn)
+    warmup_s = phases.pop("warmup_s", None)
     imgs = n_rounds * W * B
     ips = imgs / dt
     loss = float(np.asarray(metrics["results"][0]).mean())
@@ -74,11 +132,31 @@ def main():
     peak = peak_flops(jax.devices()[0])
     mfu = (flops * n_rounds / dt) / peak
     log(f"model FLOPs/round {flops:.3e}, MFU {mfu:.3f}")
-    print(json.dumps({"metric": "imagenet_fixupresnet50_round_throughput",
-                      "value": round(ips, 1), "unit": "images/sec",
-                      "mfu": round(mfu, 4),
-                      "round_images": W * B,
-                      "total_s": round(time.time() - t0, 1)}))
+    result = {"metric": "imagenet_fixupresnet50_round_throughput",
+              "value": round(ips, 1), "unit": "images/sec",
+              "mfu": round(mfu, 4),
+              "round_images": W * B,
+              "timed_rounds": n_rounds,
+              "layout": args.layout,
+              "warmup_s": warmup_s,
+              "phase_split": phases,
+              # gateable by `teleview diff --input_wait_rise` on the
+              # bench trajectory, like bench.py / bench_gpt2.py
+              "input_wait_frac": round(phases["host_s"] / dt, 6),
+              "total_s": round(time.time() - t0, 1)}
+    if telemetry is not None:
+        from commefficient_tpu.telemetry.utilization import emit_from_totals
+        emit_from_totals(
+            telemetry, rnd=n_rounds, rounds=n_rounds, wall_s=dt,
+            host_s=phases["host_s"], dispatch_s=phases["dispatch_s"],
+            device_s=phases["device_wait_s"],
+            flops_per_round=flops, flops_source="analytic",
+            device_kind=getattr(jax.devices()[0], "device_kind", "unknown"))
+        telemetry.bench_event(result["metric"], result)
+        telemetry.write_summary(aborted=False, n_rounds=n_rounds,
+                                final=result)
+        telemetry.close()
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -1,14 +1,20 @@
 #!/usr/bin/env bash
 # The single cheap green signal: schema selftest (generator and
 # validator vocabularies agree, incl. the v3 client_stats/alert types),
-# committed-artifact schema lint, then the tier-1 suite exactly as
-# ROADMAP.md specifies it (CPU backend, slow tests deselected).
+# committed-artifact schema lint, a fast-fail pass over the round-
+# pipeline tests (an input-pipeline regression — leaked thread, broken
+# determinism — fails in seconds, before the full suite), then the
+# tier-1 suite exactly as ROADMAP.md specifies it (CPU backend, slow
+# tests deselected).
 #
 # Usage: scripts/ci_fast.sh [extra pytest args...]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 python scripts/check_telemetry_schema.py --selftest runs
+
+env JAX_PLATFORMS=cpu python -m pytest tests/test_pipeline.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -43,8 +43,10 @@ chrome://tracing.
   ``--overlap_drop``;
 - the final round/epoch loss growing beyond ``--loss_ratio``x;
 - MFU dropping more than ``--mfu_drop`` (relative) or the input-wait
-  starvation fraction rising more than ``--starvation_rise``
-  (absolute), from the last ``utilization`` event of each run.
+  starvation fraction rising more than ``--input_wait_rise`` (absolute,
+  alias ``--starvation_rise``), from the last ``utilization`` event of
+  each run — the round-pipeline regression gate, exercised with its
+  default threshold by ``__graft_entry__.dryrun_multichip``.
 
 Dependency-free (json + argparse), validates nothing itself — run
 ``scripts/check_telemetry_schema.py`` for schema enforcement.
@@ -538,8 +540,13 @@ def main(argv=None) -> int:
     d.add_argument("--mfu_drop", type=float, default=0.15,
                    help="max RELATIVE drop of the final mfu (0.15 = "
                         "15%% slower per peak-FLOP fails)")
-    d.add_argument("--starvation_rise", type=float, default=0.10,
-                   help="max ABSOLUTE rise of the final input_wait_frac")
+    d.add_argument("--input_wait_rise", "--starvation_rise",
+                   dest="starvation_rise", type=float, default=0.10,
+                   help="max ABSOLUTE rise of the final input_wait_frac "
+                        "(the round-pipeline starvation gate; "
+                        "--starvation_rise kept as an alias). "
+                        "dryrun_multichip wires the default against its "
+                        "pipelined-vs-inline streams")
     d.add_argument("--client_spread_ratio", type=float, default=2.0,
                    help="max growth factor of the final per-client loss "
                         "spread (p95-p5) — population divergence")

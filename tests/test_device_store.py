@@ -59,8 +59,12 @@ def test_factory_gating(tmp_path):
 
     ds = FedCIFAR10(str(tmp_path), train=True, synthetic=True)
     assert make_device_store(ds, "CIFAR10", train=True) is not None
-    # ImageNet train augmentation has no device equivalent => host fallback
-    assert make_device_store(ds, "ImageNet", train=True) is None
+    # ImageNet train now has a device equivalent (flip + normalize on
+    # pre-sized crops — the PR-5 uint8 input fix); the resident array
+    # must stay raw uint8
+    st = make_device_store(ds, "ImageNet", train=True)
+    assert st is not None and st.augment == "imagenet_train"
+    assert str(st.arrays["image"].dtype) == "uint8"
     # unknown dataset => host fallback
     assert make_device_store(ds, "NOPE", train=True) is None
     # too big => host fallback
@@ -136,6 +140,80 @@ def test_mesh_train_loop_uses_store(tmp_path):
                     num_clients=ds.num_clients, mesh=mesh)
     state, summary = train(cfg, rt, rt.init_state(), ds, ds)
     assert summary is not None and np.isfinite(summary["train_loss"])
+
+
+def _fake_imagenet(n=6, hw=224):
+    rng = np.random.RandomState(3)
+    return {"image": rng.randint(0, 255, (n, hw, hw, 3), dtype=np.uint8),
+            "target": rng.randint(0, 8, n).astype(np.int64)}
+
+
+def test_imagenet_uint8_matches_float_path():
+    """The uint8 ImageNet store (raw bytes resident, /255 + flip +
+    normalize fused on device) matches a float-resident store numerically
+    under the same rng key — the uint8 residency changes the storage and
+    the transfer, never the values."""
+    arrays = _fake_imagenet()
+    u8 = DeviceStore(arrays, augment="imagenet_train",
+                     mean=T.IMAGENET_MEAN, std=T.IMAGENET_STD)
+    fl = DeviceStore({"image": arrays["image"].astype(np.float32) / 255.0,
+                      "target": arrays["target"]},
+                     augment="imagenet_train",
+                     mean=T.IMAGENET_MEAN, std=T.IMAGENET_STD)
+    assert str(u8.arrays["image"].dtype) == "uint8"
+    # uint8 image residency is 4x smaller than float32
+    assert u8.arrays["image"].nbytes * 4 == fl.arrays["image"].nbytes
+    idx = np.arange(4).reshape(2, 2)            # (W, B) round shape
+    a = u8.round_batch(idx, jax.random.PRNGKey(0))
+    b = fl.round_batch(idx, jax.random.PRNGKey(0))
+    assert a["image"].shape == (2, 2, 224, 224, 3)
+    assert a["image"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(a["image"]),
+                               np.asarray(b["image"]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_imagenet_train_flip_semantics_and_eval_equality():
+    """Each 224^2 train output equals the host normalize of the image or
+    of its horizontal mirror (the ImagenetTrain augmentation family);
+    different keys flip differently; the eval store equals the host
+    ImagenetEval exactly."""
+    arrays = _fake_imagenet()
+    st = DeviceStore(arrays, augment="imagenet_train",
+                     mean=T.IMAGENET_MEAN, std=T.IMAGENET_STD)
+    idx = np.arange(4)
+    got = np.asarray(st.round_batch(idx, jax.random.PRNGKey(0))["image"])
+    host = T.ImagenetEval()({"image": arrays["image"][idx]})["image"]
+    for i in range(4):
+        plain = np.allclose(got[i], host[i], atol=1e-4)
+        mirror = np.allclose(got[i], host[i][:, ::-1], atol=1e-4)
+        assert plain or mirror, i
+    got2 = np.asarray(st.round_batch(idx, jax.random.PRNGKey(3))["image"])
+    assert float(np.abs(got - got2).max()) > 0    # keys flip differently
+    ev = DeviceStore(arrays, augment="normalize",
+                     mean=T.IMAGENET_MEAN, std=T.IMAGENET_STD)
+    np.testing.assert_allclose(
+        np.asarray(ev.round_batch(idx, None)["image"]), host,
+        rtol=1e-5, atol=1e-6)
+
+
+def test_imagenet_factory_and_no_augment():
+    """make_device_store wires ImageNet train to the device path (uint8
+    resident) and still honors no_augment -> normalize-only."""
+    arrays = _fake_imagenet(n=4, hw=32)         # small: gating only
+
+    class FakeDs:
+        def __init__(self):
+            self.arrays = arrays
+            self.do_iid = False
+
+    st = make_device_store(FakeDs(), "ImageNet", train=True)
+    assert st is not None and st.augment == "imagenet_train"
+    st2 = make_device_store(FakeDs(), "ImageNet", train=True,
+                            no_augment=True)
+    assert st2 is not None and st2.augment == "normalize"
+    ev = make_device_store(FakeDs(), "ImageNet", train=False)
+    assert ev is not None and ev.augment == "normalize"
 
 
 def test_emnist_train_augment_on_device():
