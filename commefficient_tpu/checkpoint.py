@@ -217,7 +217,8 @@ def _shapes_need_migration(z, d_pad, num_clients, d_row_pad) -> bool:
     """Whether any stored field's shape differs from the restoring
     runtime's targets (in which case the host-side migration path must
     run)."""
-    for name in ("ps_weights", "Vvelocity", "Verror", "coord_last_update"):
+    for name in ("ps_weights", "Vvelocity", "Verror", "coord_last_update",
+                 "async_buffer"):
         if d_pad is not None and f"{name}__shape" in z.files:
             shape = tuple(z[f"{name}__shape"])
             if len(shape) == 1 and shape[0] != d_pad:
@@ -363,8 +364,12 @@ def load_state(path: str, sharding=None, d_pad: Optional[int] = None,
     if kw.get("nan_round") is None:
         kw["nan_round"] = np.full((), -1, np.int32)
     if d_pad is not None:
+        # async_buffer migrates like the other dense server vectors; a
+        # non-empty buffer is loudly reset by the driver anyway
+        # (core/async_agg.reconcile_resumed_state), so padding/slicing
+        # zeros here only keeps the shapes loadable across topologies
         for name in ("ps_weights", "Vvelocity", "Verror",
-                     "coord_last_update"):
+                     "coord_last_update", "async_buffer"):
             arr = kw.get(name)
             if arr is not None and arr.ndim == 1 and arr.shape[0] != d_pad:
                 if arr.shape[0] < d_pad:
@@ -501,7 +506,9 @@ class CheckpointManager:
                        allow_missing_fingerprint=False, d_pad=None,
                        num_clients=None, d_row_pad=None,
                        expect_sketch_gen=_UNSET,
-                       sketch_mismatch_ok=False):
+                       sketch_mismatch_ok=False,
+                       expect_async_gen=_UNSET,
+                       async_mismatch_ok=False):
         """Returns (state, meta) or (None, {}). When the caller carries a
         params fingerprint, a mismatch — or a checkpoint that predates
         fingerprinting and so carries none — raises instead of resuming into
@@ -530,6 +537,14 @@ class CheckpointManager:
             self._check_sketch_gen(meta.get("sketch_gen"),
                                    expect_sketch_gen, sketch_mismatch_ok,
                                    self._path(e))
+        if expect_async_gen is not _UNSET and expect_async_gen is not None:
+            # async-aggregation vintage, checked against the META before
+            # any state is materialized (the sketch_gen pattern): an
+            # async run resuming a checkpoint that carries no async
+            # ledger cannot verify the buffer/commit bookkeeping it is
+            # about to continue
+            self._check_async_gen(meta.get("async_gen"), expect_async_gen,
+                                  async_mismatch_ok, self._path(e))
         saved_fp = meta.get("params_fingerprint")
         if expect_fingerprint is not None:
             if saved_fp is None and not allow_missing_fingerprint:
@@ -599,3 +614,34 @@ class CheckpointManager:
             "momentum/error tables would decode under the wrong shifts. "
             "Re-create the run, or pass --resume_unverified to DISCARD "
             "the sketch state and continue from the weights.")
+
+    @staticmethod
+    def _check_async_gen(saved_gen, expect_gen: str, mismatch_ok: bool,
+                         path: str) -> None:
+        """Async-aggregation vintage check (marker format:
+        cv_train.setup_checkpointing). Only the missing-marker case is
+        fatal — a checkpoint written before async buffered aggregation
+        (or by a synchronous run) records no buffer/commit ledger, so an
+        async resume cannot verify what it is continuing. A marker that
+        merely differs (other discount/goal parameters) is a stderr
+        warning: commits are atomic, the buffer is flushed at every
+        epoch boundary, and any non-empty restored buffer is loudly
+        restarted by core/async_agg.reconcile_resumed_state — nothing
+        can double-count."""
+        if saved_gen == expect_gen:
+            return
+        if saved_gen is None:
+            if mismatch_ok:
+                return  # caller resumes with a fresh, empty buffer
+            raise ValueError(
+                f"checkpoint {path} predates async buffered aggregation "
+                "(it carries no async_gen marker): the resume cannot "
+                "verify the buffer state or commit ledger this "
+                f"--async_agg run ({expect_gen!r}) would continue. Pass "
+                "--resume_unverified to resume with a FRESH, EMPTY "
+                "buffer — that is safe (commits are atomic, nothing "
+                "double-counts); the async commit counter restarts.")
+        print(f"WARNING: async-aggregation parameters changed "
+              f"({saved_gen!r} -> {expect_gen!r}); resuming anyway — the "
+              "buffer is committed/flushed atomically, so only future "
+              "merges use the new discount", file=sys.stderr)

@@ -336,6 +336,49 @@ class FedConfig:
     # how many rounds the prefetcher runs ahead (queue bound). 2 =
     # double-buffered: one batch in flight to the device, one staged
     prefetch_depth: int = 2
+
+    # --- async buffered aggregation (core/async_agg.py; FedBuff-style,
+    # Nguyen et al. 2022). Off by default: the lockstep round is the
+    # reference-parity path. When on, the driver keeps up to
+    # ``max_inflight`` cohort computations in flight, merges each
+    # cohort's transmitted-space sum into a server-side buffer as it
+    # "lands" (simulated arrival order from data/scenarios.py), applies
+    # ``staleness_discount`` per merged cohort, and commits the buffered
+    # aggregate through the normal server momentum+EF step once
+    # ``buffer_goal`` cohorts have merged. Sound only for modes whose
+    # server consumes the cohort uploads purely through their weighted
+    # SUM — no per-client persistent rows, no topk_down (see
+    # core/async_agg.validate_async_combo, which fails fast otherwise).
+    async_agg: bool = False
+    # cohorts kept in flight (K). Dispatching past K forces the
+    # earliest in-flight cohort to land first — the simulated "pool is
+    # full" wait. Each in-flight cohort holds one transmitted-space
+    # array on device.
+    max_inflight: int = 4
+    # cohorts merged per commit (M). 1 commits every landing cohort;
+    # with max_inflight 1 and no scenario latency that reduces exactly
+    # to the synchronous round (bit-identical, dryrun-asserted).
+    buffer_goal: int = 1
+    # staleness discount applied to a cohort merged s commits after its
+    # dispatch: "none" = 1, "poly" = (1+s)^-alpha (FedBuff's default
+    # shape; alpha 0.5 reproduces its 1/sqrt(1+s)), "exp" =
+    # exp(-alpha*s). All rules give weight exactly 1.0 at s=0.
+    staleness_discount: str = "poly"
+    staleness_alpha: float = 0.5
+
+    # --- straggler scenario engine (data/scenarios.py): per-cohort
+    # simulated latency / dropout / dynamic partial participation,
+    # seeded deterministically off (seed, global round index) so runs
+    # replay exactly. Only meaningful with --async_agg (the lockstep
+    # loop has no notion of a late cohort) — configuring a scenario
+    # without it fails fast instead of silently doing nothing.
+    scenario: str = "none"          # none | uniform | lognormal | stragglers
+    scenario_latency: float = 1.0   # base latency, in cohort-dispatch ticks
+    scenario_spread: float = 0.5    # uniform half-width / lognormal sigma
+    scenario_straggler_frac: float = 0.1   # "stragglers" kind: slow fraction
+    scenario_straggler_mult: float = 10.0  # ... and their latency multiplier
+    scenario_dropout: float = 0.0   # per-cohort probability of never landing
+    scenario_participation: float = 1.0  # fraction of worker slots kept
     # rematerialize transformer blocks on backward (memory/FLOPs trade)
     do_remat: bool = False
     # selective-remat policy (jax.checkpoint_policies attribute name, e.g.
@@ -405,7 +448,48 @@ class FedConfig:
         assert self.alert_action in ALERT_ACTIONS, self.alert_action
         assert self.alert_window >= 4, self.alert_window
         assert self.alert_zscore > 0, self.alert_zscore
-        assert self.prefetch_depth >= 1, self.prefetch_depth
+        if self.pipeline and self.prefetch_depth < 1:
+            # depth < 1 with pipelining on used to silently degrade to the
+            # inline fetch (RoundPipeline treated depth<=0 as "threading
+            # off") — a user asking for prefetch would get none and no
+            # message. Fail with the fix spelled out instead.
+            raise ValueError(
+                f"--prefetch_depth {self.prefetch_depth} is invalid with "
+                "the round input pipeline enabled: the prefetcher needs a "
+                "queue bound of at least 1 (2 = double-buffered). Pass "
+                "--prefetch_depth >= 1, or --no_pipeline to run the fetch "
+                "inline.")
+        if self.prefetch_depth < 1:
+            raise ValueError(
+                f"--prefetch_depth {self.prefetch_depth} must be >= 1")
+        # async buffered aggregation (mode-compatibility guards live in
+        # core/async_agg.validate_async_combo, next to validate_mode_combo)
+        assert self.staleness_discount in ("none", "poly", "exp"), \
+            self.staleness_discount
+        assert self.staleness_alpha > 0, self.staleness_alpha
+        if self.async_agg:
+            if self.buffer_goal < 1:
+                raise ValueError(
+                    f"--buffer_goal {self.buffer_goal} must be >= 1")
+            if self.max_inflight < 1:
+                raise ValueError(
+                    f"--max_inflight {self.max_inflight} must be >= 1")
+        assert self.scenario in ("none", "uniform", "lognormal",
+                                 "stragglers"), self.scenario
+        assert 0.0 <= self.scenario_dropout < 1.0, self.scenario_dropout
+        assert 0.0 < self.scenario_participation <= 1.0, \
+            self.scenario_participation
+        if not self.async_agg and (
+                self.scenario != "none" or self.scenario_dropout > 0
+                or self.scenario_participation < 1.0):
+            # a scenario without async aggregation would silently do
+            # nothing — the lockstep loop never consults it (the exact
+            # silently-ignored-flag failure the repo fails fast on)
+            raise ValueError(
+                "--scenario/--scenario_dropout/--scenario_participation "
+                "require --async_agg: the synchronous round loop has no "
+                "notion of a late, dropped or partially-participating "
+                "cohort, so the scenario would be silently ignored.")
         if self.profile_dir:
             # a bad window spec must fail at startup, not at round START
             from commefficient_tpu.telemetry.profiling import \
@@ -695,7 +779,52 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                         "prefetch overlap)")
     p.add_argument("--prefetch_depth", type=int, default=2,
                    help="rounds the input pipeline prefetches ahead "
-                        "(2 = double-buffered)")
+                        "(2 = double-buffered; must be >= 1 with the "
+                        "pipeline enabled)")
+    p.add_argument("--async_agg", action="store_true",
+                   help="FedBuff-style async buffered aggregation "
+                        "(core/async_agg.py): keep --max_inflight cohorts "
+                        "in flight, merge landed cohort sums with "
+                        "--staleness_discount weighting, commit the "
+                        "buffer through the server momentum+EF step every "
+                        "--buffer_goal cohorts")
+    p.add_argument("--max_inflight", type=int, default=4,
+                   help="cohort computations kept in flight (K); each "
+                        "holds one transmitted-space array on device")
+    p.add_argument("--buffer_goal", type=int, default=1,
+                   help="cohorts merged per server commit (M); 1 commits "
+                        "every landing cohort")
+    p.add_argument("--staleness_discount",
+                   choices=("none", "poly", "exp"), default="poly",
+                   help="merge weight for a cohort s commits stale: none "
+                        "= 1, poly = (1+s)^-alpha, exp = exp(-alpha*s)")
+    p.add_argument("--staleness_alpha", type=float, default=0.5,
+                   help="staleness discount exponent/rate (poly 0.5 = "
+                        "FedBuff's 1/sqrt(1+s))")
+    p.add_argument("--scenario",
+                   choices=("none", "uniform", "lognormal", "stragglers"),
+                   default="none",
+                   help="straggler scenario engine (data/scenarios.py): "
+                        "per-cohort simulated latency distribution; "
+                        "requires --async_agg")
+    p.add_argument("--scenario_latency", type=float, default=1.0,
+                   help="base cohort latency, in dispatch ticks")
+    p.add_argument("--scenario_spread", type=float, default=0.5,
+                   help="latency spread (uniform half-width / lognormal "
+                        "sigma)")
+    p.add_argument("--scenario_straggler_frac", type=float, default=0.1,
+                   help="'stragglers' kind: fraction of cohorts that are "
+                        "slow")
+    p.add_argument("--scenario_straggler_mult", type=float, default=10.0,
+                   help="'stragglers' kind: latency multiplier of the "
+                        "slow cohorts")
+    p.add_argument("--scenario_dropout", type=float, default=0.0,
+                   help="per-cohort probability of never landing (the "
+                        "compute is skipped; nothing merges)")
+    p.add_argument("--scenario_participation", type=float, default=1.0,
+                   help="fraction of the round's worker slots that "
+                        "actually participate (the rest are masked out "
+                        "per cohort, deterministically)")
     p.add_argument("--remat", action="store_true", dest="do_remat")
     p.add_argument("--remat_policy", type=str, default="")
     p.add_argument("--lm_chunk", type=int, default=0)

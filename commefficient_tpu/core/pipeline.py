@@ -111,7 +111,17 @@ class RoundPipeline:
         self._fetch = fetch
         self._start = int(start_round)
         self._max = max_rounds if max_rounds is None else int(max_rounds)
-        self.threaded = bool(enabled) and depth > 0
+        if enabled and depth < 1:
+            # this used to silently degrade to the inline fetch — a
+            # caller asking for prefetch got none and no message. The
+            # config layer rejects it too (FedConfig.__post_init__);
+            # this guard covers direct constructions.
+            raise ValueError(
+                f"RoundPipeline(depth={depth}) with enabled=True: the "
+                "prefetcher needs a queue bound >= 1 (2 = double-"
+                "buffered). Pass depth >= 1, or enabled=False for the "
+                "inline fetch.")
+        self.threaded = bool(enabled)
         self._exhausted = False
         self._thread: Optional[threading.Thread] = None
         if self.threaded:

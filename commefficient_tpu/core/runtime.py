@@ -241,8 +241,21 @@ class FedRuntime:
         # Gated on telemetry too: with --no_telemetry nothing ever reads
         # them, and in sketch mode on a mesh the l2estimate diagnostics
         # cost two table-sized all-gathers per round — never pay a hot-
-        # path collective for a stream nobody consumes.
-        self._signals = cfg.signals and cfg.telemetry
+        # path collective for a stream nobody consumes. Async buffered
+        # aggregation (core/async_agg.py) splits the round around the
+        # signal computation sites (the signals compare the round's agg
+        # against the SAME round's server update, which async decouples),
+        # so signals are off there — loudly, not silently: the
+        # async_round event's EF norms are the async health channel.
+        self._signals = cfg.signals and cfg.telemetry and not cfg.async_agg
+        if cfg.signals and cfg.telemetry and cfg.async_agg:
+            import sys
+            print("NOTE: --async_agg disables the per-round `signals` "
+                  "diagnostics (they compare a round's aggregate against "
+                  "the same round's server update, which buffered "
+                  "aggregation decouples); commit-granularity EF norms "
+                  "are emitted on the `async_round` events instead. Pass "
+                  "--no_signals to silence this note.", file=sys.stderr)
         # the dense pre-encode aggregate exists only where the deferred
         # encode runs once on one device — capture it there so sketch
         # mode gets grad_true_norm (the collision-noise reference); on a
@@ -341,6 +354,39 @@ class FedRuntime:
         else:
             self._val = jax.jit(self._val_step)
 
+        # async buffered aggregation (core/async_agg.py): the round splits
+        # into a client-compute cohort step (dispatch time) and a server
+        # commit step (buffer-goal time), plus a trivial merge. Built only
+        # under --async_agg — the synchronous path compiles nothing new.
+        self._cohort = self._commit_jit = self._merge_jit = None
+        if cfg.async_agg:
+            from commefficient_tpu.core.async_agg import validate_async_combo
+            validate_async_combo(cfg)
+            if self.shardings is not None:
+                sh = self.shardings
+                cs_sh = jax.tree.map(lambda _: sh.replicated, self.cs)
+                self._cohort = jax.jit(
+                    self._cohort_step, donate_argnums=(0,),
+                    in_shardings=(self._state_sharding, sh.round_axis,
+                                  self.batch_sharding(), sh.round_axis,
+                                  None, cs_sh),
+                    out_shardings=(self._state_sharding, None))
+                self._commit_jit = jax.jit(
+                    self._commit_step, donate_argnums=(0,),
+                    in_shardings=(self._state_sharding, None, cs_sh),
+                    out_shardings=(self._state_sharding, None))
+                self._merge_jit = jax.jit(
+                    self._merge_step, donate_argnums=(0,),
+                    in_shardings=(self._state_sharding, None, None, None),
+                    out_shardings=self._state_sharding)
+            else:
+                self._cohort = jax.jit(self._cohort_step,
+                                       donate_argnums=(0,))
+                self._commit_jit = jax.jit(self._commit_step,
+                                           donate_argnums=(0,))
+                self._merge_jit = jax.jit(self._merge_step,
+                                          donate_argnums=(0,))
+
     def set_compile_watcher(self, watcher) -> None:
         """Compile observability hook (telemetry.JitWatcher): wraps the
         jitted round/val steps so every lowering+compile — including
@@ -354,6 +400,9 @@ class FedRuntime:
         self._compile_watched = True
         self._round = watcher.wrap("round_step", self._round)
         self._val = watcher.wrap("val_step", self._val)
+        if self._cohort is not None:
+            self._cohort = watcher.wrap("cohort_step", self._cohort)
+            self._commit_jit = watcher.wrap("commit_step", self._commit_jit)
 
     def _probe_seq_grad_scale(self) -> float:
         """Measure how the round's cross-seq-shard gradient sum over-counts
@@ -465,6 +514,11 @@ class FedRuntime:
             nan_round=jnp.full((), -1, jnp.int32),
             sig_Vvelocity=maybe((d,), self._signals_shadow),
             sig_Verror=maybe((d,), self._signals_shadow),
+            # async buffered aggregation (core/async_agg.py): the merge
+            # buffer lives in FedState so it shards/checkpoints exactly
+            # like the server EF state it feeds
+            async_buffer=maybe(server_tx, cfg.async_agg),
+            async_buffer_n=maybe((), cfg.async_agg),
         )
 
     # ------------------------------------------------------------- round step
@@ -846,6 +900,10 @@ class FedRuntime:
             nan_round=nan_round,
             sig_Vvelocity=sig_vel_new,
             sig_Verror=sig_err_new,
+            # pass-through: the synchronous round never touches the async
+            # buffer (the two paths are mutually exclusive per config)
+            async_buffer=state.async_buffer,
+            async_buffer_n=state.async_buffer_n,
         )
         metrics = {
             "results": out.results,          # tuple of (num_workers,) arrays
@@ -892,6 +950,290 @@ class FedRuntime:
             in_specs=(P(axes), jax.tree.map(lambda _: item, batch), item),
             out_specs=(tuple(P() for _ in range(nres)), P()),
             check_vma=False)(ps_weights, batch, mask)
+
+    # -------------------------------------------- async buffered aggregation
+    #
+    # The synchronous _round_step fuses client compute and the server
+    # update into one program; async buffered aggregation (FedBuff-style,
+    # core/async_agg.py) needs them apart: cohort gradients are computed
+    # against the weights AT DISPATCH, land out of order, merge into the
+    # FedState buffer by (staleness-weighted) addition, and the server
+    # momentum+EF step runs only when the buffer goal is reached. The
+    # three pieces below mirror the sync step's code EXACTLY over the
+    # combinations validate_async_combo admits (no per-client persistent
+    # rows, no topk_down) — with max_inflight=1, buffer_goal=1 and no
+    # scenario latency the composition is bit-identical to _round_step
+    # (asserted per mode by __graft_entry__.dryrun_multichip).
+
+    def _cohort_step(self, state: FedState, client_ids: jax.Array,
+                     batch: Any, mask: jax.Array, lr: jax.Array, cs=None):
+        """Client half of the round: the same client block as
+        _round_step, stopping BEFORE the datum normalization and server
+        update. Advances only the dispatch-time state (rng, download
+        byte accounting, nan flag) and returns the cohort payload: the
+        UNNORMALIZED transmitted-space sum, its datum count, per-client
+        results/stats, and the round's exact byte costs."""
+        cfg = self.cfg
+        num_workers = client_ids.shape[0]
+        keys = jax.random.split(state.rng, num_workers + 1)
+        rng, client_rngs = keys[0], keys[1:]
+
+        # download byte accounting at DISPATCH: the client reads the
+        # weights of server version ``state.step`` (in async mode the
+        # step counter advances per COMMIT — the server version)
+        download_bytes = upload_bytes = None
+        down_slot = up_slot = None
+        client_last_round = state.client_last_round
+        if cfg.track_bytes:
+            thresholds = state.client_last_round[client_ids]
+            counts = (state.coord_last_update[None, :]
+                      >= thresholds[:, None]).sum(axis=1)
+            down_slot = 4.0 * counts.astype(jnp.float32)
+            up_slot = jnp.full((num_workers,), 4.0 * cfg.upload_floats,
+                               jnp.float32)
+            download_bytes = jnp.zeros(self.num_clients, jnp.float32).at[
+                client_ids].set(down_slot)
+            upload_bytes = jnp.zeros(self.num_clients, jnp.float32).at[
+                client_ids].set(up_slot)
+            client_last_round = state.client_last_round.at[client_ids].set(
+                state.step)
+
+        def client_block(used_weights, batch, mask, client_rngs, lr, cs):
+            # validate_async_combo guarantees no vel/err rows and no
+            # topk_down here — otherwise byte-for-byte the sync block
+            used = used_weights[: cfg.grad_size]
+            td = self._table_dtype
+            wire = (td != jnp.float32 and not self._dense_preimage
+                    and cfg.mode == "sketch")
+            if cfg.mode == "fedavg":
+                lr_c = lr[: cfg.grad_size] if lr.ndim == 1 else lr
+                out = jax.vmap(
+                    self._client_fn, in_axes=(None, 0, 0, None, 0))(
+                        used, batch, mask, lr_c, client_rngs)
+                agg = out.transmit.sum(axis=0)
+            elif self._fused:
+                agg, f_results, f_nvalid = self._fused_fn(used, batch, mask)
+                out = client_lib.ClientOut(None, None, None, f_results,
+                                           f_nvalid)
+            else:
+                out = jax.vmap(
+                    self._client_fn,
+                    in_axes=(None, 0, 0, None, None, 0, None))(
+                        used, batch, mask, None, None, client_rngs, cs)
+                tx = out.transmit
+                if wire and not self._defer_encode and tx.ndim == 3:
+                    tx = tx.astype(td).astype(jnp.float32)
+                agg = tx.sum(axis=0)
+            if self._defer_encode and not self._dense_preimage:
+                agg = cs.encode(agg)
+            if wire and self._axis is None and agg.ndim == 2:
+                agg = agg.astype(td).astype(jnp.float32)
+            n_total = out.n_valid.sum()
+            if self._axis is not None:
+                all_axes = tuple(self.mesh.axis_names)
+                if agg.ndim == 1:
+                    agg = lax.psum_scatter(
+                        jnp.pad(agg, (0, self.d_pad - cfg.grad_size)),
+                        all_axes, scatter_dimension=0, tiled=True)
+                else:
+                    if td != jnp.float32 and agg.ndim == 2:
+                        agg = lax.optimization_barrier(
+                            lax.psum(agg.astype(td), all_axes))
+                        agg = agg.astype(jnp.float32)
+                    else:
+                        agg = lax.psum(agg, all_axes)
+                if self._seq_axis is not None:
+                    agg = agg / self._seq_grad_scale
+                n_total = lax.psum(n_total, self._axis)
+            return agg, n_total, out.results, out.n_valid, out.stats
+
+        if self._axis is not None:
+            ax = self._axis
+            row = P(ax)
+            if self._seq_axis and self._seq_spec:
+                batch_specs = {k: self._batch_pspec(sd)
+                               for k, sd in self._seq_spec.items()}
+            else:
+                batch_specs = jax.tree.map(lambda _: row, batch)
+            in_specs = (P(), batch_specs, row, row, P(),
+                        jax.tree.map(lambda _: P(), cs))
+            dense_agg_spec = P(tuple(self.mesh.axis_names))
+            out_specs = (
+                dense_agg_spec if cfg.mode != "sketch" else P(),
+                P(),
+                tuple(row for _ in range(cfg.num_results_train)),
+                row,
+                ({k: row for k in CLIENT_GRAD_KEYS}
+                 if self._client_grad_stats else None),
+            )
+            client_block = shard_map(client_block, mesh=self.mesh,
+                                     in_specs=in_specs, out_specs=out_specs,
+                                     check_vma=False)
+
+        agg, n_total, results, n_valid, grad_stats = client_block(
+            state.ps_weights, batch, mask, client_rngs, lr, cs)
+
+        client_stats = None
+        if self._client_stats:
+            per_client = {"loss": results[0]}
+            if grad_stats is not None:
+                per_client.update(grad_stats)
+            else:
+                nan_w = jnp.full((num_workers,), jnp.nan, jnp.float32)
+                per_client.update({k: nan_w for k in CLIENT_GRAD_KEYS})
+            if cfg.track_bytes:
+                per_client["upload_bytes"] = up_slot
+                per_client["download_bytes"] = down_slot
+            rep = None
+            if self.mesh is not None:
+                rep_sh = NamedSharding(self.mesh, P())
+
+                def rep(x, _sh=rep_sh):
+                    return lax.with_sharding_constraint(x, _sh)
+            client_stats = summarize_per_client(per_client, n_valid,
+                                                replicate_fn=rep)
+
+        # dispatch-side divergence detection: a poisoned cohort sum must
+        # be flagged before it can merge into the buffer
+        bad = ~jnp.isfinite(agg).all() | ~jnp.isfinite(results[0]).all()
+        nan_round = jnp.where((state.nan_round < 0) & bad, state.step,
+                              state.nan_round)
+
+        new_state = state.replace(rng=rng, client_last_round=client_last_round,
+                                  nan_round=nan_round)
+        payload = {
+            "sum": agg,                  # UNNORMALIZED weighted client sum
+            "n_total": n_total,          # datum count of this cohort
+            "results": results,
+            "n_valid": n_valid,
+            "download_bytes": download_bytes,
+            "upload_bytes": upload_bytes,
+            "client_stats": client_stats,
+        }
+        return new_state, payload
+
+    def _merge_step(self, state: FedState, cohort_sum: jax.Array,
+                    n_total: jax.Array, weight: jax.Array) -> FedState:
+        """Fold one landed cohort into the buffer: pure weighted addition
+        (the merge soundness condition — sketch tables and dense sums are
+        both linear in the uploads). The datum count accumulates RAW,
+        not discounted: the commit divides the weighted sum by the true
+        datum total (FedBuff's divide-by-K), so a stale cohort's
+        contribution is genuinely attenuated by its weight instead of
+        the discount cancelling between numerator and denominator."""
+        return state.replace(
+            async_buffer=state.async_buffer + weight * cohort_sum,
+            async_buffer_n=state.async_buffer_n + n_total)
+
+    def _commit_step(self, state: FedState, lr: jax.Array, cs=None):
+        """Server half of the round: normalize the buffered aggregate,
+        run the mode's momentum+EF update (core/server.py — identical
+        code to the sync round), apply it to the weights, and reset the
+        buffer. ``step`` advances here: it is the server version."""
+        cfg = self.cfg
+        rng, server_rng = jax.random.split(state.rng)
+        total = jnp.maximum(state.async_buffer_n, 1.0)
+        agg = state.async_buffer / total
+
+        server_lr = jnp.asarray(1.0) if cfg.mode == "fedavg" else lr
+        if (cfg.mode == "sketch" and not self._dense_preimage
+                and server_lr.ndim == 1):
+            server_lr = server_lr[: cfg.grad_size]
+        update, Vvel, Verr, _sup_mask = server_update(
+            cfg, agg, state.Vvelocity, state.Verror, server_lr,
+            cs=cs, dp_rng=server_rng,
+            dense_preimage=self._dense_preimage)
+
+        if self.d_pad != cfg.grad_size:
+            if update.shape[0] == cfg.grad_size:
+                update = jnp.pad(update, (0, self.d_pad - cfg.grad_size))
+            else:
+                update = jnp.where(
+                    jnp.arange(self.d_pad) < cfg.grad_size, update, 0.0)
+        ps_weights = state.ps_weights - update
+
+        coord_last_update = state.coord_last_update
+        if cfg.track_bytes:
+            coord_last_update = jnp.where(
+                update != 0, state.step, state.coord_last_update)
+
+        bad = ~jnp.isfinite(update).all() | ~jnp.isfinite(agg).all()
+        nan_round = jnp.where((state.nan_round < 0) & bad, state.step,
+                              state.nan_round)
+
+        new_state = state.replace(
+            ps_weights=ps_weights,
+            Vvelocity=Vvel,
+            Verror=Verr,
+            step=state.step + 1,
+            rng=rng,
+            coord_last_update=coord_last_update,
+            nan_round=nan_round,
+            async_buffer=jnp.zeros_like(state.async_buffer),
+            async_buffer_n=jnp.zeros_like(state.async_buffer_n),
+        )
+        # commit health scalars for the async_round telemetry event: the
+        # post-commit EF-accumulator norms are the staleness-divergence
+        # signal telemetry/health.py watches
+        metrics = {
+            "update_norm": jnp.linalg.norm(update),
+            "error_norm": jnp.linalg.norm(Verr),
+            "velocity_norm": jnp.linalg.norm(Vvel),
+            "buffer_n": state.async_buffer_n,
+        }
+        return new_state, metrics
+
+    def _prep_lr(self, lr) -> jax.Array:
+        lr = jnp.asarray(lr, jnp.float32)
+        if lr.ndim == 1 and lr.shape[0] != self.d_pad:
+            lr = jnp.pad(lr, (0, self.d_pad - lr.shape[0]),
+                         constant_values=1.0)
+        return lr
+
+    def cohort(self, state: FedState, client_ids, batch, mask, lr
+               ) -> Tuple[FedState, Dict]:
+        """Dispatch one cohort's client compute (async mode). Same
+        argument contract as :meth:`round`; returns (state', payload)
+        where payload carries the unnormalized transmitted-space sum the
+        AsyncAggregator later merges."""
+        assert self._cohort is not None, "--async_agg is off"
+        with tracing.span("cohort_dispatch"):
+            return self._cohort(state, jnp.asarray(client_ids, jnp.int32),
+                                batch, jnp.asarray(mask),
+                                self._prep_lr(lr), self.cs)
+
+    def merge(self, state: FedState, cohort_sum, n_total,
+              weight: float) -> FedState:
+        """Merge a landed cohort into the buffer with its staleness
+        weight. ``weight == 1.0`` into an EMPTY buffer swaps the arrays
+        in directly — bitwise-exact, the sync-equivalence path (the
+        generic path computes ``buffer + w*sum``, and 0 + x flips the
+        sign of -0.0 coordinates)."""
+        return self._merge_jit(state, cohort_sum,
+                               jnp.asarray(n_total, jnp.float32),
+                               jnp.asarray(weight, jnp.float32))
+
+    def merge_first(self, state: FedState, cohort_sum,
+                    n_total) -> FedState:
+        """Weight-1.0 merge into an empty buffer: a pytree swap, no
+        arithmetic (see :meth:`merge`). On a mesh the cohort sum is
+        re-laid-out to the buffer's canonical state sharding first — a
+        pure layout copy, bitwise identical — so the commit/cohort jits'
+        pinned in_shardings keep matching."""
+        n_total = jnp.asarray(n_total, jnp.float32)
+        if self._state_sharding is not None:
+            cohort_sum = jax.device_put(cohort_sum,
+                                        self._state_sharding.async_buffer)
+            n_total = jax.device_put(n_total,
+                                     self._state_sharding.async_buffer_n)
+        return state.replace(async_buffer=cohort_sum,
+                             async_buffer_n=n_total)
+
+    def commit(self, state: FedState, lr) -> Tuple[FedState, Dict]:
+        """Commit the buffered aggregate through the server step."""
+        assert self._commit_jit is not None, "--async_agg is off"
+        with tracing.span("commit_dispatch"):
+            return self._commit_jit(state, self._prep_lr(lr), self.cs)
 
     # -------------------------------------------------------------- user API
 

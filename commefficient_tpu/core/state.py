@@ -59,3 +59,17 @@ class FedState:
     # from zero instead of the signal silently going dead.
     sig_Vvelocity: Optional[jax.Array] = None      # (d,) fp32
     sig_Verror: Optional[jax.Array] = None         # (d,) fp32
+    # async buffered aggregation (core/async_agg.py), allocated only
+    # under --async_agg: the staleness-weighted sum of landed-but-
+    # uncommitted cohort uploads (transmitted shape — sketch table or
+    # dense vector, exactly like Vvelocity) and their RAW datum count
+    # (NOT discounted — FedBuff's divide-by-K; weighting the denominator
+    # too would cancel the staleness attenuation, see
+    # runtime._merge_step). Living in FedState means the buffer checkpoints/restores
+    # with everything else; ``step`` counts COMMITS in async mode (the
+    # server version), not dispatches. A resumed run must never reuse a
+    # non-empty buffer (the epoch replays from its boundary, so the
+    # buffered cohorts would be recomputed and double-counted) — the
+    # drivers loudly zero it, see async_agg.reconcile_resumed_state.
+    async_buffer: Optional[jax.Array] = None       # transmitted shape
+    async_buffer_n: Optional[jax.Array] = None     # () fp32

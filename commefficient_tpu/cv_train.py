@@ -126,20 +126,35 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
                       # not tables — a cross-state resume must refuse
                       + ("-densestate"
                          if cfg.sketch_server_state == "dense" else ""))
-    mgr.default_meta = {"params_fingerprint": fp, "sketch_gen": sketch_gen}
+    # async-aggregation vintage marker: records that (and how) this run
+    # buffers, so a resume can refuse an unverifiable ledger BEFORE any
+    # state is materialized (see checkpoint._check_async_gen). Written as
+    # None by synchronous runs — absent and None are the same vintage.
+    async_gen = None
+    if cfg.async_agg:
+        async_gen = (f"v1-{cfg.staleness_discount}"
+                     f"-a{cfg.staleness_alpha}"
+                     f"-M{cfg.buffer_goal}-K{cfg.max_inflight}")
+    mgr.default_meta = {"params_fingerprint": fp, "sketch_gen": sketch_gen,
+                        "async_gen": async_gen}
     if cfg.do_resume:
         # the sketch-generation marker is checked against the checkpoint's
         # META (inside restore_latest) BEFORE any state is materialized —
         # in particular a table-state checkpoint resumed under
         # --sketch_server_state dense fails with the layout explanation
-        # instead of a raw array-shape error mid-load
+        # instead of a raw array-shape error mid-load. The async marker
+        # is checked the same way: a pre-async checkpoint resumed into an
+        # --async_agg run refuses with the buffer-ledger explanation
+        # unless --resume_unverified opts into a fresh, empty buffer
         restored, meta = mgr.restore_latest(
             sharding=runtime._state_sharding, expect_fingerprint=fp,
             allow_missing_fingerprint=cfg.resume_unverified,
             d_pad=runtime.d_pad, num_clients=runtime.num_clients,
             d_row_pad=runtime.d_row_pad,
             expect_sketch_gen=sketch_gen,
-            sketch_mismatch_ok=cfg.resume_unverified)
+            sketch_mismatch_ok=cfg.resume_unverified,
+            expect_async_gen=async_gen,
+            async_mismatch_ok=cfg.resume_unverified)
         if restored is not None:
             saved_gen = meta.get("sketch_gen")
             if saved_gen != sketch_gen and sketch_gen is not None:
@@ -172,6 +187,18 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
                 # drop it so the state matches this runtime's template
                 restored = restored.replace(sig_Vvelocity=None,
                                             sig_Verror=None)
+            # async buffer reconciliation (core/async_agg.py): a missing
+            # buffer initializes EMPTY, a NON-EMPTY one (mid-epoch
+            # postmortem) is LOUDLY restarted — the epoch replays from
+            # its boundary, so restoring the buffer would double-count
+            # its cohorts; and an async checkpoint resumed synchronously
+            # drops the fields to match this runtime's template
+            from commefficient_tpu.core.async_agg import \
+                reconcile_resumed_state
+            restored, async_msgs = reconcile_resumed_state(restored,
+                                                           runtime)
+            for m in async_msgs:
+                print(f"WARNING: {m}", file=sys.stderr)
             start = int(meta.get("epoch", 0))
             print(f"resumed from epoch {start}")
             return mgr, start, restored
@@ -299,6 +326,23 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
             # universe — observes the sampler's (host-resident) ids, so
             # it costs no device traffic and runs EVERY round
             ledger = ParticipationLedger(train_ds.num_clients)
+    # async buffered aggregation (core/async_agg.py): the round splits
+    # into dispatch-time cohort compute and buffer-goal commits; the
+    # scenario engine (data/scenarios.py) decides each cohort's
+    # latency/dropout/participation deterministically off the global
+    # round index. One aggregator for the whole run; the epoch boundary
+    # flushes it, so checkpoints never straddle an open buffer.
+    async_agg = None
+    if cfg.async_agg:
+        from commefficient_tpu.core.async_agg import (AsyncAggregator,
+                                                      commit_loss)
+        from commefficient_tpu.data.scenarios import make_scenario
+        async_agg = AsyncAggregator(runtime, scenario=make_scenario(cfg))
+        print(f"async aggregation: K={async_agg.max_inflight} in flight, "
+              f"commit every M={async_agg.buffer_goal} cohorts, "
+              f"{async_agg.discount} staleness discount"
+              + ("" if async_agg.scenario is None
+                 else f", scenario={cfg.scenario}"))
     # device-resident data path: upload the dataset once, gather + augment
     # each round's batch on device, accumulate metrics on device, and fetch
     # once per epoch — a host<->device transfer costs ~170 ms latency on
@@ -384,14 +428,22 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                 lr_arr = (jnp.asarray(lr, jnp.float32) if lr_mult is None
                           else lr * lr_mult)
                 prof.maybe_start(global_round)
-                state, metrics = runtime.round(
-                    state, rnd.client_ids, batch, rnd.mask, lr_arr)
+                commits = ()
+                if async_agg is not None:
+                    # metrics is None for a scenario-dropped cohort (no
+                    # compute happened — nothing to record or accumulate)
+                    state, metrics, commits = async_agg.step(
+                        state, rnd, global_round, batch, lr_arr)
+                else:
+                    state, metrics = runtime.round(
+                        state, rnd.client_ids, batch, rnd.mask, lr_arr)
                 t_dispatch = time.perf_counter()
                 prof.maybe_stop(global_round,
                                 lambda: jax.block_until_ready(state.ps_weights))
                 every = cfg.telemetry_round_every
                 record = (telemetry is not None and every
-                          and global_round % every == 0)
+                          and global_round % every == 0
+                          and metrics is not None)
                 t_device = t_dispatch
                 if record:
                     # each round record costs ONE host sync of the round's
@@ -401,9 +453,12 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                     with tracing.span("device_wait"):
                         jax.block_until_ready(metrics)
                     t_device = time.perf_counter()
-                if util is not None:
+                if util is not None and metrics is not None:
                     # device_s is only measured on synced (record) rounds;
-                    # the tracker treats None as "not measured", not zero
+                    # the tracker treats None as "not measured", not zero.
+                    # Scenario-dropped cohorts are not observed at all: no
+                    # device work ran, and counting them as rounds would
+                    # quietly deflate the window's per-round MFU
                     util.observe_round(
                         host_s=host_s,
                         dispatch_s=t_dispatch - t_loop,
@@ -413,10 +468,18 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                 # captured, so the host fetch + JSONL writes below (and
                 # their flush latency) land in NO measured phase — they
                 # are visible instead as the telemetry_emit span
-                if ledger is not None:
-                    # sampler ids/mask are host arrays: no device fetch
-                    ledger.observe(global_round, rnd.client_ids,
-                                   np.asarray(rnd.mask).sum(axis=1))
+                if ledger is not None and metrics is not None:
+                    # sampler ids/mask are host arrays: no device fetch.
+                    # In async mode the scenario may have masked slots
+                    # out of the cohort — observe the EFFECTIVE
+                    # participation the aggregator reports, not the
+                    # sampler's intent
+                    if async_agg is not None:
+                        obs_ids, obs_n = metrics["participation"]
+                    else:
+                        obs_ids = rnd.client_ids
+                        obs_n = np.asarray(rnd.mask).sum(axis=1)
+                    ledger.observe(global_round, obs_ids, obs_n)
                 if record:
                     with tracing.span("telemetry_emit"):
                         res = [np.asarray(r) for r in metrics["results"]]
@@ -462,10 +525,16 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                             # per-client population quantiles (device-
                             # reduced, telemetry/clients.py) + the
                             # participation ledger snapshot
+                            # async: the scenario may have masked slots
+                            # out — count the EFFECTIVE participants
+                            # (slots that carried data), matching what
+                            # the quantile weights and the ledger saw
+                            n_part = (int((np.asarray(obs_n) > 0).sum())
+                                      if async_agg is not None
+                                      else len(np.asarray(rnd.client_ids)))
                             telemetry.client_stats_event(
                                 rnd=global_round,
-                                n_participants=len(
-                                    np.asarray(rnd.client_ids)),
+                                n_participants=n_part,
                                 quantiles=client_stats_to_host(
                                     metrics["client_stats"],
                                     rnd.client_ids),
@@ -476,10 +545,24 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                         # this round's trace lands in the next drain
                         util.emit(global_round)
                     telemetry.span_event(tracer)
+                if telemetry is not None and commits:
+                    # async commit records (schema v4 async_round): the
+                    # host-side staleness/discount bookkeeping is free
+                    # and emitted for EVERY commit; the device-derived
+                    # fields (loss, buffer_n, EF norms) cost a host sync
+                    # each, so they ride only the record cadence — off
+                    # it they are null, never fake zeros
+                    for c in commits:
+                        telemetry.async_round_event(
+                            rec=c, lr=float(lr),
+                            loss=(commit_loss(c) if record else None),
+                            with_device=record)
+                if record or (telemetry is not None and commits):
                     # ---- alert actions (telemetry/health.py): the
                     # monitor already wrote its alert events while the
-                    # records above were emitted; here the driver owns
-                    # the side effects that need the live state
+                    # records above were emitted (async_round included);
+                    # here the driver owns the side effects that need
+                    # the live state
                     if recorder is not None:
                         req = monitor.pop_snapshot_request()
                         if req is not None:
@@ -499,6 +582,12 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                             final=telemetry.last_epoch)
                         telemetry.fsync()
                         return state, None
+                if metrics is None:
+                    # scenario-dropped cohort: no compute happened, so
+                    # there is nothing to count or accumulate
+                    if cfg.do_test:
+                        break
+                    continue
                 rounds_run += 1
                 if telemetry is not None and rounds_run == 1:
                     # device memory after the first round: weights + server
@@ -526,6 +615,20 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
             # which is fine only because nothing trains on this dataset
             # stream afterwards (see RoundPipeline.close)
             pipe.close()
+            if async_agg is not None:
+                # drain the in-flight pool and commit any partial buffer:
+                # epochs (and therefore checkpoints, which are written at
+                # epoch granularity below) never straddle an open buffer
+                flush_lr = schedule(global_round / spe)
+                flush_lr_arr = (jnp.asarray(flush_lr, jnp.float32)
+                                if lr_mult is None else flush_lr * lr_mult)
+                state, fcommits = async_agg.flush(state, flush_lr_arr)
+                if telemetry is not None:
+                    for c in fcommits:
+                        telemetry.async_round_event(rec=c,
+                                                    lr=float(flush_lr),
+                                                    loss=commit_loss(c),
+                                                    with_device=True)
             if util is not None:
                 # close the round window at the epoch boundary: the
                 # validation sweep below must not dilute the round MFU

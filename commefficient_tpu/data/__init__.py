@@ -10,6 +10,8 @@ from commefficient_tpu.data.fed_cifar import FedCIFAR10, FedCIFAR100
 from commefficient_tpu.data.fed_emnist import FedEMNIST
 from commefficient_tpu.data.fed_imagenet import FedImageNet
 from commefficient_tpu.data.fed_persona import FedPERSONA, persona_collate
+from commefficient_tpu.data.scenarios import (CohortFate, StragglerScenario,
+                                              make_scenario)
 from commefficient_tpu.data.transforms import transforms_for
 
 _REGISTRY = {
@@ -40,6 +42,9 @@ __all__ = [
     "FedImageNet",
     "FedPERSONA",
     "persona_collate",
+    "CohortFate",
+    "StragglerScenario",
+    "make_scenario",
     "transforms_for",
     "get_dataset",
 ]

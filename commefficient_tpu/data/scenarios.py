@@ -1,0 +1,137 @@
+"""Straggler scenario engine: deterministic per-cohort fates for async
+buffered aggregation (core/async_agg.py).
+
+A "scenario" decides, for each dispatched cohort, three things a real
+federated deployment exhibits and the lockstep simulator never did:
+
+- **latency** — how many dispatch ticks pass before the cohort's upload
+  lands at the server (the AsyncAggregator merges in arrival order, so
+  latency is what produces staleness);
+- **dropout** — whether the cohort never lands at all (churn: the
+  driver skips the compute entirely, nothing merges);
+- **partial participation** — which of the round's worker slots
+  actually participate (the rest are masked out, contributing no data
+  but keeping the static shapes the jitted round needs).
+
+Determinism contract: every fate derives from ``(seed, cohort_idx)``
+alone — ``np.random.default_rng((seed, cohort_idx))`` — never from call
+order or shared mutable RNG state, so a run replays bit-identically
+across resumes, prefetch interleavings and in-flight pool sizes (the
+same contract core/pipeline.py keys its augmentation randomness on).
+
+Latency kinds:
+
+- ``none``       — 0 ticks (no staleness; dropout/participation still
+  apply);
+- ``uniform``    — U[max(latency - spread, 0), latency + spread];
+- ``lognormal``  — exp(N(ln latency, spread)), the classic heavy-ish
+  device-speed distribution;
+- ``stragglers`` — a two-point mixture: ``latency`` ticks for most
+  cohorts, ``latency * straggler_mult`` for a ``straggler_frac``
+  minority — the sharpest tool for staleness-discount studies.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+SCENARIO_KINDS = ("none", "uniform", "lognormal", "stragglers")
+
+
+class CohortFate(NamedTuple):
+    """What the scenario decided for one cohort."""
+
+    latency: float        # dispatch ticks until the upload lands
+    dropped: bool         # True: the cohort never lands (skip compute)
+    mask: np.ndarray      # (num_workers, B) bool, participation-reduced
+
+
+class StragglerScenario:
+    """Deterministic per-cohort fate generator (see module docstring)."""
+
+    def __init__(self, kind: str = "none", *, seed: int = 0,
+                 latency: float = 1.0, spread: float = 0.5,
+                 straggler_frac: float = 0.1,
+                 straggler_mult: float = 10.0,
+                 dropout: float = 0.0, participation: float = 1.0):
+        if kind not in SCENARIO_KINDS:
+            raise ValueError(f"unknown scenario kind {kind!r}; "
+                             f"choices: {SCENARIO_KINDS}")
+        if latency < 0 or spread < 0:
+            raise ValueError("latency/spread must be >= 0")
+        if not 0.0 <= dropout < 1.0:
+            raise ValueError(f"dropout must be in [0, 1), got {dropout}")
+        if not 0.0 < participation <= 1.0:
+            raise ValueError(
+                f"participation must be in (0, 1], got {participation}")
+        if not 0.0 <= straggler_frac <= 1.0:
+            raise ValueError(
+                f"straggler_frac must be in [0, 1], got {straggler_frac}")
+        self.kind = kind
+        self.seed = int(seed)
+        self.latency = float(latency)
+        self.spread = float(spread)
+        self.straggler_frac = float(straggler_frac)
+        self.straggler_mult = float(straggler_mult)
+        self.dropout = float(dropout)
+        self.participation = float(participation)
+
+    def _latency(self, rng: np.random.Generator) -> float:
+        if self.kind == "none":
+            return 0.0
+        if self.kind == "uniform":
+            lo = max(self.latency - self.spread, 0.0)
+            return float(rng.uniform(lo, self.latency + self.spread))
+        if self.kind == "lognormal":
+            mu = math.log(max(self.latency, 1e-9))
+            return float(rng.lognormal(mean=mu, sigma=self.spread))
+        # stragglers: two-point mixture
+        lat = self.latency
+        if rng.random() < self.straggler_frac:
+            lat *= self.straggler_mult
+        return float(lat)
+
+    def fate(self, cohort_idx: int, mask: np.ndarray) -> CohortFate:
+        """Fate of cohort ``cohort_idx`` (the global round index).
+
+        The per-cohort draws happen in a FIXED order (latency, dropout,
+        participation) from a fresh ``(seed, cohort_idx)``-keyed
+        generator, so a fate never depends on which other cohorts were
+        asked about. Participation only ever REMOVES slots (mask & keep)
+        and always keeps at least one, so a participating cohort always
+        carries data.
+        """
+        rng = np.random.default_rng((self.seed, int(cohort_idx)))
+        latency = self._latency(rng)
+        dropped = bool(rng.random() < self.dropout)
+        mask = np.asarray(mask)
+        out_mask = mask
+        if self.participation < 1.0:
+            keep = rng.random(mask.shape[0]) < self.participation
+            if not keep.any():
+                keep[int(rng.integers(mask.shape[0]))] = True
+            out_mask = mask & keep[:, None]
+        return CohortFate(latency, dropped, out_mask)
+
+
+def make_scenario(cfg, seed: Optional[int] = None
+                  ) -> Optional[StragglerScenario]:
+    """Build the configured scenario from a FedConfig, or None when the
+    configuration is trivial (no latency kind, no dropout, full
+    participation) — the AsyncAggregator treats None as
+    latency-0/no-drop, skipping the per-cohort RNG work entirely."""
+    if (cfg.scenario == "none" and cfg.scenario_dropout == 0.0
+            and cfg.scenario_participation >= 1.0):
+        return None
+    return StragglerScenario(
+        cfg.scenario,
+        seed=int(cfg.seed if seed is None else seed),
+        latency=cfg.scenario_latency,
+        spread=cfg.scenario_spread,
+        straggler_frac=cfg.scenario_straggler_frac,
+        straggler_mult=cfg.scenario_straggler_mult,
+        dropout=cfg.scenario_dropout,
+        participation=cfg.scenario_participation)

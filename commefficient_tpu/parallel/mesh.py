@@ -146,7 +146,9 @@ class FedShardings:
             if name in ("client_weights", "client_last_round"):
                 return self.client_rows
             if name in ("ps_weights", "coord_last_update", "Vvelocity",
-                        "Verror"):
+                        "Verror", "async_buffer"):
+                # async_buffer shards exactly like Vvelocity: it holds
+                # the same transmitted-space quantity (core/async_agg.py)
                 if like.ndim == 2:       # sketch table (r, c)
                     return (self.sketch_table if like.shape[1] % n == 0
                             else self.replicated)

@@ -159,6 +159,14 @@ class ParticipationLedger:
                   if samples_per_slot is not None
                   else np.ones(len(ids)))
         for c, n in zip(ids.tolist(), counts.tolist()):
+            if n <= 0:
+                # a zero-sample slot did not participate: the async
+                # scenario engine's partial-participation masking zeroes
+                # whole slots (data/scenarios.py), and crediting them
+                # would reset the client's staleness without it having
+                # contributed anything. Sync rounds never produce these
+                # (the sampler only yields slots with data).
+                continue
             c = int(c)
             self._samples[c] = self._samples.get(c, 0.0) + float(n)
             self._last_round[c] = int(rnd)

@@ -50,7 +50,8 @@ from typing import Any, Dict, List, Optional
 
 SEVERITIES = ("info", "warn", "critical")
 # event kinds RunTelemetry forwards to an attached monitor
-MONITORED_KINDS = ("round", "signals", "utilization", "client_stats")
+MONITORED_KINDS = ("round", "signals", "utilization", "client_stats",
+                   "async_round")
 
 # The rule table: each rule watches ONE field of ONE event kind.
 # kind="z" fires on a robust z-score breach of the rolling history
@@ -81,6 +82,19 @@ RULES = (
          severity="info"),
     dict(name="client_loss_spread", event="client_stats",
          field="loss_spread", kind="z", direction="high", severity="warn"),
+    # async buffered aggregation (core/async_agg.py, schema v4): the
+    # staleness-induced EF-divergence precursor — stale discounted
+    # cohorts leaking into the virtual error accumulator show up as
+    # error_norm growth at COMMIT granularity rounds before the loss
+    # goes non-finite (the same failure shape as the sync EF blowups,
+    # observed on the async_round stream instead of signals)
+    dict(name="async_ef_blowup", event="async_round", field="error_norm",
+         kind="z", direction="high", severity="critical"),
+    dict(name="async_loss_spike", event="async_round", field="loss",
+         kind="z", direction="high", severity="warn"),
+    dict(name="staleness_spike", event="async_round",
+         field="staleness_max", kind="z", direction="high",
+         severity="info"),
 )
 
 

@@ -313,6 +313,36 @@ class RunTelemetry:
                    n_participants=int(n_participants),
                    quantiles=quantiles, **participation)
 
+    def async_round_event(self, *, rec: Dict[str, Any], lr: float,
+                          loss: Optional[float] = None,
+                          with_device: bool = False) -> None:
+        """One async buffered-aggregation commit (core/async_agg.py
+        commit record). ``with_device=True`` fetches the record's device
+        scalar refs (buffer_n and the post-commit norms) — the caller
+        opts in only at the record cadence, because each fetch is a host
+        sync; off-cadence commits record their (host-side) staleness
+        bookkeeping with the device fields null."""
+
+        def dev(key):
+            if not with_device or rec.get(key) is None:
+                return None
+            import numpy as np
+            return float(np.asarray(rec[key]))
+
+        self.event("async_round", round=int(rec["round"]),
+                   n_cohorts=int(rec["n_cohorts"]),
+                   cohorts=[int(c) for c in rec["cohorts"]],
+                   staleness_mean=float(rec["staleness_mean"]),
+                   staleness_max=float(rec["staleness_max"]),
+                   discount_mean=float(rec["discount_mean"]),
+                   discount_min=float(rec["discount_min"]),
+                   partial=bool(rec["partial"]),
+                   buffer_n=dev("buffer_n"), loss=loss,
+                   update_norm=dev("update_norm"),
+                   error_norm=dev("error_norm"),
+                   velocity_norm=dev("velocity_norm"),
+                   lr=float(lr))
+
     def alert_event(self, *, rnd: int, rule: str, severity: str,
                     metric: str, value: Optional[float] = None,
                     zscore: Optional[float] = None,

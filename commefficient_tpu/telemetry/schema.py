@@ -20,13 +20,14 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterable, List, Tuple
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 # streams written by older code stay readable: v1 lacks the span /
 # utilization event types (added in v2), v2 lacks client_stats / alert
-# (added in v3), but each is otherwise a subset of its successor — so
-# the validator accepts any supported manifest version. A version it
-# does not know is the error, not a version merely older than current.
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, SCHEMA_VERSION)
+# (added in v3), v3 lacks async_round (added in v4), but each is
+# otherwise a subset of its successor — so the validator accepts any
+# supported manifest version. A version it does not know is the error,
+# not a version merely older than current.
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, SCHEMA_VERSION)
 TELEMETRY_BASENAME = "telemetry.jsonl"
 
 
@@ -239,6 +240,33 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "counts_max": _opt_num,
         "staleness_p50": _opt_num,    # rounds since last participation
         "staleness_max": _opt_num,
+    },
+    # one async buffered-aggregation commit (core/async_agg.py): which
+    # cohorts merged, their measured staleness (commits between dispatch
+    # and merge) and discount weights, the raw datum count the commit
+    # averaged over, and the post-commit EF-accumulator norms —
+    # the staleness-divergence signal health.py's async_ef_blowup rule
+    # watches. ``round`` is the COMMIT index (the server version), not a
+    # dispatch tick; ``partial`` marks the epoch-boundary flush of a
+    # buffer below --buffer_goal. loss is the datum-weighted dispatch
+    # loss of the merged cohorts; the device-derived fields (loss,
+    # buffer_n, *_norm) are null off the record cadence — fetching them
+    # costs a host sync, and a null is never a fake zero
+    "async_round": {
+        "round": _int,
+        "n_cohorts": _int,
+        "cohorts": _list,             # global round index of each cohort
+        "staleness_mean": _num,
+        "staleness_max": _num,
+        "discount_mean": _num,
+        "discount_min": _num,
+        "partial": _bool,
+        "buffer_n": _opt_num,
+        "loss": _opt_num,
+        "update_norm": _opt_num,
+        "error_norm": _opt_num,
+        "velocity_norm": _opt_num,
+        "lr": _num,
     },
     # online anomaly alert (telemetry/health.py): a monitor rule fired
     # against the rolling median/MAD history of a watched stream field.

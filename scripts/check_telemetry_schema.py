@@ -65,6 +65,19 @@ _SAMPLE_OVERRIDES = {
     "counts_max": 16.0,
     "staleness_p50": 1.0,
     "staleness_max": 3.0,
+    # async_round: one realistic schema-v4 commit (two merged cohorts,
+    # one of them a commit stale, poly-discounted; device fields set as
+    # a record-cadence event would carry them)
+    "cohorts": [11, 12],
+    "staleness_mean": 0.5,
+    "staleness_max": 1.0,
+    "discount_mean": 0.9,
+    "discount_min": 0.8165,
+    "buffer_n": 14.0,
+    "partial": False,
+    "update_norm": 0.25,
+    "error_norm": 1.5,
+    "velocity_norm": 0.75,
     # alert: a fired statistical rule
     "rule": "loss_spike",
     "severity": "warn",

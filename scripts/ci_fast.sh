@@ -16,6 +16,13 @@ python scripts/check_telemetry_schema.py --selftest runs
 env JAX_PLATFORMS=cpu python -m pytest tests/test_pipeline.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
+# async buffered aggregation + scenario engine: a regression here
+# (broken sync-equivalence, unsound merge, scenario nondeterminism)
+# fails in seconds, before the full suite
+env JAX_PLATFORMS=cpu python -m pytest tests/test_async_agg.py \
+    tests/test_scenarios.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

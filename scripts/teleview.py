@@ -46,7 +46,11 @@ chrome://tracing.
   starvation fraction rising more than ``--input_wait_rise`` (absolute,
   alias ``--starvation_rise``), from the last ``utilization`` event of
   each run — the round-pipeline regression gate, exercised with its
-  default threshold by ``__graft_entry__.dryrun_multichip``.
+  default threshold by ``__graft_entry__.dryrun_multichip``;
+- on async buffered-aggregation streams (schema v4), the final
+  ``async_round`` staleness_mean rising more than ``--staleness_rise``
+  (absolute, commits-stale units), or its post-commit error_norm
+  growing beyond ``--signal_ratio``x (staleness-induced EF divergence).
 
 Dependency-free (json + argparse), validates nothing itself — run
 ``scripts/check_telemetry_schema.py`` for schema enforcement.
@@ -87,6 +91,13 @@ except ImportError:
 NORM_KEYS = ("grad_norm", "grad_true_norm", "grad_l2estimate",
              "velocity_norm", "error_norm", "error_l2estimate",
              "update_norm")
+
+# async_round fields the analyzer reads (schema v4, core/async_agg.py).
+# Literal on purpose — this tool must run jax-free; tests/test_async_agg
+# pins these names against telemetry/schema.EVENT_FIELDS["async_round"].
+ASYNC_ROUND_KEYS = ("staleness_mean", "staleness_max", "discount_mean",
+                    "discount_min", "error_norm", "loss", "n_cohorts",
+                    "partial")
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
@@ -216,6 +227,26 @@ def summarize(events: List[Dict[str, Any]], label: str = "") -> None:
                 continue
             print(f"   {key:18s} first {vals[0]:11.5g} last {vals[-1]:11.5g}"
                   f" min {min(vals):11.5g} max {max(vals):11.5g}")
+
+    asyncs = by_kind(events, "async_round")
+    if asyncs:
+        # the staleness line: commits, merged-cohort staleness trend,
+        # discount floor, partial flushes — the async-aggregation health
+        # summary (schema v4, core/async_agg.py)
+        sm = [_fin(e.get("staleness_mean")) for e in asyncs]
+        sm = [v for v in sm if v is not None]
+        smax = max((_fin(e.get("staleness_max")) or 0.0) for e in asyncs)
+        dmin = min((_fin(e.get("discount_min")) or 1.0) for e in asyncs)
+        n_partial = sum(1 for e in asyncs if e.get("partial"))
+        errs = [_fin(e.get("error_norm")) for e in asyncs]
+        errs = [v for v in errs if v is not None]
+        line = (f"-- async: {len(asyncs)} commits, staleness mean "
+                f"{sm[0]:.2f} -> {sm[-1]:.2f} (max {smax:.0f}), "
+                f"discount floor {dmin:.3f}, {n_partial} partial flush"
+                + ("es" if n_partial != 1 else ""))
+        if errs:
+            line += f"; error_norm first {errs[0]:.5g} last {errs[-1]:.5g}"
+        print(line)
 
     epochs = by_kind(events, "epoch")
     if epochs:
@@ -469,6 +500,26 @@ def diff(a: List[Dict[str, Any]], b: List[Dict[str, Any]],
                 f"(rise > {args.starvation_rise:.2f} — the input "
                 "pipeline started starving the chip)")
 
+    aa, ab = by_kind(a, "async_round"), by_kind(b, "async_round")
+    if aa and ab:
+        za = _fin(aa[-1].get("staleness_mean"))
+        zb = _fin(ab[-1].get("staleness_mean"))
+        if za is not None and zb is not None \
+                and zb > za + args.staleness_rise:
+            problems.append(
+                f"async_round: final staleness_mean {za:.2f} -> {zb:.2f} "
+                f"(rise > {args.staleness_rise:.2f} — cohorts are landing "
+                "later relative to commits; the in-flight pool or the "
+                "buffer goal regressed)")
+        ea = _fin(aa[-1].get("error_norm"))
+        eb = _fin(ab[-1].get("error_norm"))
+        if ea is not None and eb is not None and ea > 0 \
+                and eb > ea * args.signal_ratio:
+            problems.append(
+                f"async_round: final error_norm {ea:.5g} -> {eb:.5g} "
+                f"(> {args.signal_ratio:.2f}x — staleness-induced EF "
+                "divergence class)")
+
     def final_loss(events):
         eps = by_kind(events, "epoch")
         if eps:
@@ -547,6 +598,10 @@ def main(argv=None) -> int:
                         "--starvation_rise kept as an alias). "
                         "dryrun_multichip wires the default against its "
                         "pipelined-vs-inline streams")
+    d.add_argument("--staleness_rise", type=float, default=1.0,
+                   help="max ABSOLUTE rise of the final async_round "
+                        "staleness_mean (async buffered-aggregation "
+                        "runs; commits-stale units)")
     d.add_argument("--client_spread_ratio", type=float, default=2.0,
                    help="max growth factor of the final per-client loss "
                         "spread (p95-p5) — population divergence")

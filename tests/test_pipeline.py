@@ -156,6 +156,28 @@ def test_inline_mode_runs_no_thread():
     pipe.close()                        # no-op
 
 
+def test_invalid_prefetch_depth_raises():
+    """depth < 1 with pipelining enabled used to SILENTLY degrade to the
+    inline fetch; it must now raise a clear config error — at the
+    RoundPipeline layer and at the FedConfig layer (regression test for
+    the PR-6 satellite fix). enabled=False still accepts any depth."""
+    for depth in (0, -1):
+        with pytest.raises(ValueError, match="queue bound"):
+            RoundPipeline(_rounds(3), lambda r, g: {"g": g},
+                          start_round=0, depth=depth, enabled=True)
+    from commefficient_tpu.config import FedConfig
+    with pytest.raises(ValueError, match="--prefetch_depth"):
+        FedConfig(prefetch_depth=0)
+    with pytest.raises(ValueError, match="--prefetch_depth"):
+        FedConfig(prefetch_depth=0, pipeline=False)
+    # no thread was created by the failed constructions
+    assert _no_prefetch_threads()
+    # inline mode still accepts any depth >= 1 semantics via enabled=False
+    pipe = RoundPipeline(_rounds(2), lambda r, g: {"g": g}, start_round=0,
+                         depth=0, enabled=False)
+    assert [i.global_round for i in pipe] == [1, 2]
+
+
 def test_wait_vs_fetch_accounting():
     """Pipelined, wait_s is the consumer's queue wait while fetch_s keeps
     the worker's true cost — input_wait_frac measures starvation, not the

@@ -1,0 +1,118 @@
+"""Straggler scenario engine (data/scenarios.py): fate determinism off
+(seed, cohort_idx), latency distributions, dropout rates, participation
+masking invariants, and the config factory's trivial-scenario elision."""
+
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.data.scenarios import (StragglerScenario,
+                                              make_scenario)
+
+MASK = np.ones((4, 3), bool)
+
+
+def test_fate_deterministic_across_instances_and_order():
+    """Same (seed, cohort_idx) -> identical fate, regardless of which
+    instance produced it or in what order cohorts were asked about —
+    the replay contract async resumes and prefetch interleavings need."""
+    kw = dict(seed=7, latency=2.0, spread=0.5, dropout=0.2,
+              participation=0.7)
+    a = StragglerScenario("lognormal", **kw)
+    b = StragglerScenario("lognormal", **kw)
+    fates_fwd = [a.fate(i, MASK) for i in range(20)]
+    fates_rev = [b.fate(i, MASK) for i in reversed(range(20))][::-1]
+    for fa, fb in zip(fates_fwd, fates_rev):
+        assert fa.latency == fb.latency
+        assert fa.dropped == fb.dropped
+        np.testing.assert_array_equal(fa.mask, fb.mask)
+
+
+def test_different_seed_or_cohort_changes_fate():
+    a = StragglerScenario("lognormal", seed=1, latency=2.0, spread=1.0)
+    b = StragglerScenario("lognormal", seed=2, latency=2.0, spread=1.0)
+    lat_a = [a.fate(i, MASK).latency for i in range(32)]
+    lat_b = [b.fate(i, MASK).latency for i in range(32)]
+    assert lat_a != lat_b
+    assert len(set(lat_a)) > 1  # per-cohort variation, not a constant
+
+
+def test_kind_none_zero_latency_but_dropout_applies():
+    s = StragglerScenario("none", seed=3, dropout=0.5)
+    fates = [s.fate(i, MASK) for i in range(200)]
+    assert all(f.latency == 0.0 for f in fates)
+    drop_rate = np.mean([f.dropped for f in fates])
+    assert 0.3 < drop_rate < 0.7
+
+
+def test_uniform_latency_bounds():
+    s = StragglerScenario("uniform", seed=0, latency=3.0, spread=1.0)
+    lats = [s.fate(i, MASK).latency for i in range(100)]
+    assert all(2.0 <= lt <= 4.0 for lt in lats)
+    # spread wider than the mean clamps at zero, never negative
+    s2 = StragglerScenario("uniform", seed=0, latency=0.5, spread=2.0)
+    assert all(s2.fate(i, MASK).latency >= 0.0 for i in range(100))
+
+
+def test_straggler_mixture_two_point():
+    s = StragglerScenario("stragglers", seed=5, latency=1.0,
+                          straggler_frac=0.25, straggler_mult=10.0)
+    lats = np.asarray([s.fate(i, MASK).latency for i in range(400)])
+    assert set(np.unique(lats)) == {1.0, 10.0}
+    frac = (lats == 10.0).mean()
+    assert 0.15 < frac < 0.35
+
+
+def test_participation_masks_slots_never_adds_keeps_one():
+    s = StragglerScenario("none", seed=9, participation=0.5)
+    base = np.ones((6, 4), bool)
+    base[5, 1:] = False  # an already-partial slot stays partial
+    saw_reduction = False
+    for i in range(50):
+        f = s.fate(i, base)
+        # only ever REMOVES: mask & keep
+        assert not (f.mask & ~base).any()
+        # at least one slot still participates
+        assert f.mask.any()
+        if f.mask.sum() < base.sum():
+            saw_reduction = True
+    assert saw_reduction
+    # participation=1.0 leaves the mask untouched (same object semantics)
+    s_full = StragglerScenario("none", seed=9, participation=1.0)
+    np.testing.assert_array_equal(s_full.fate(0, base).mask, base)
+
+
+def test_invalid_parameters_raise():
+    with pytest.raises(ValueError, match="unknown scenario kind"):
+        StragglerScenario("gaussian")
+    with pytest.raises(ValueError):
+        StragglerScenario("none", dropout=1.0)
+    with pytest.raises(ValueError):
+        StragglerScenario("none", participation=0.0)
+    with pytest.raises(ValueError):
+        StragglerScenario("uniform", latency=-1.0)
+
+
+def test_make_scenario_elides_trivial_and_builds_configured():
+    cfg = FedConfig(async_agg=True)
+    assert make_scenario(cfg) is None
+    cfg2 = FedConfig(async_agg=True, scenario="stragglers",
+                     scenario_latency=2.0, scenario_dropout=0.1)
+    s = make_scenario(cfg2)
+    assert isinstance(s, StragglerScenario)
+    assert s.kind == "stragglers" and s.latency == 2.0
+    assert s.seed == cfg2.seed
+    # dropout alone (kind none) is NOT trivial
+    assert make_scenario(FedConfig(async_agg=True,
+                                   scenario_dropout=0.1)) is not None
+
+
+def test_scenario_without_async_agg_fails_fast():
+    """A scenario the lockstep loop would silently ignore must refuse
+    at config time (the repo's silently-ignored-flag contract)."""
+    with pytest.raises(ValueError, match="require --async_agg"):
+        FedConfig(scenario="stragglers")
+    with pytest.raises(ValueError, match="require --async_agg"):
+        FedConfig(scenario_dropout=0.2)
+    with pytest.raises(ValueError, match="require --async_agg"):
+        FedConfig(scenario_participation=0.5)
