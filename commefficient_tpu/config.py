@@ -21,6 +21,13 @@ MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed")
 ERROR_TYPES = ("none", "local", "virtual")
 DP_MODES = ("worker", "server")
 ALERT_ACTIONS = ("log", "warn", "checkpoint", "abort")
+# adversarial client injection (data/scenarios.py AdversaryPlan):
+# deterministic per-client fates keyed off (seed, client_id)
+ADVERSARY_KINDS = ("none", "labelflip", "signflip", "scale", "noise", "nan")
+# robust aggregation in transmitted space (core/server.py)
+DEFENSES = ("none", "normclip", "trim")
+# what the round does with a nonfinite per-client update (core/runtime.py)
+NONFINITE_ACTIONS = ("abort", "quarantine")
 
 # reference: CommEfficient/utils.py:37-44
 FED_DATASETS = {
@@ -379,6 +386,54 @@ class FedConfig:
     scenario_straggler_mult: float = 10.0  # ... and their latency multiplier
     scenario_dropout: float = 0.0   # per-cohort probability of never landing
     scenario_participation: float = 1.0  # fraction of worker slots kept
+    # --- adversarial client injection (data/scenarios.py AdversaryPlan).
+    # A deterministic --adversary_frac fraction of the client universe is
+    # hostile, keyed off (seed, client_id) — the same client misbehaves
+    # every time it is sampled, across resumes and prefetch interleavings.
+    # Kinds: labelflip (train on (C-1)-y — data space, needs a
+    # classification dataset), signflip (upload x -1), scale (upload
+    # x adversary_scale — the boosted/model-replacement attack), noise
+    # (upload + adversary_scale * N(0, I) in transmitted space), nan
+    # (upload all-NaN — the broken-client case --nonfinite_action
+    # handles). Unlike the latency scenario, injection works in BOTH the
+    # synchronous and async rounds (it acts at cohort compute, which both
+    # paths share).
+    adversary: str = "none"
+    adversary_frac: float = 0.0
+    # scale attack multiplier / noise attack sigma
+    adversary_scale: float = 10.0
+    # --- robust aggregation in transmitted space (core/server.py):
+    # - normclip: per-client update-norm clipping to a robust threshold —
+    #   rolling-median of past rounds' median per-datum update norms
+    #   (defense_window rounds, FedState.defense_ref) x defense_clip_mult
+    #   (Sun et al. 2019). Sound in table space too: an l2 clip is a
+    #   rescaling, and rescaling commutes with the linear sketch.
+    # - trim: per-coordinate trimmed-mean aggregation — drop the
+    #   defense_trim_frac highest and lowest per-client values per
+    #   coordinate, average the rest uniformly (Yin et al. 2018). Single
+    #   device only (the cross-client sort needs every client's full
+    #   vector in one place; on a mesh use normclip).
+    # Off by default; the defended round's HLO is byte-identical to the
+    # pre-defense round when off (same discipline as signals).
+    defense: str = "none"
+    defense_clip_mult: float = 3.0
+    defense_window: int = 8
+    defense_trim_frac: float = 0.1
+    # --- nonfinite recovery (core/runtime.py + core/quarantine.py):
+    # - abort (default): the pre-existing behavior — the first nonfinite
+    #   per-client update poisons the aggregate, the device flag fires,
+    #   the run stops at the epoch boundary.
+    # - quarantine: the nonfinite client's upload is zeroed OUT of the
+    #   aggregate inside the jitted round (its datum count and metrics
+    #   contributions too), the client id is logged to a host-side
+    #   QuarantineLedger, and the client is benched for
+    #   quarantine_backoff rounds, retried, and permanently ejected
+    #   after quarantine_strikes strikes. A FULLY-nonfinite round (no
+    #   finite client left) still aborts. Costs one (W,)-bool host fetch
+    #   per round for the ledger.
+    nonfinite_action: str = "abort"
+    quarantine_backoff: int = 8
+    quarantine_strikes: int = 3
     # rematerialize transformer blocks on backward (memory/FLOPs trade)
     do_remat: bool = False
     # selective-remat policy (jax.checkpoint_policies attribute name, e.g.
@@ -490,6 +545,52 @@ class FedConfig:
                 "require --async_agg: the synchronous round loop has no "
                 "notion of a late, dropped or partially-participating "
                 "cohort, so the scenario would be silently ignored.")
+        # adversarial injection / defense / quarantine (the robustness
+        # subsystem): validate the numerics here, mode/topology
+        # compatibility at runtime init (core/server.validate_defense_combo
+        # needs the resolved mesh)
+        assert self.adversary in ADVERSARY_KINDS, self.adversary
+        assert self.defense in DEFENSES, self.defense
+        assert self.nonfinite_action in NONFINITE_ACTIONS, \
+            self.nonfinite_action
+        if not 0.0 <= self.adversary_frac <= 1.0:
+            raise ValueError(
+                f"--adversary_frac {self.adversary_frac} must be in [0, 1]")
+        if self.adversary != "none" and self.adversary_frac == 0.0:
+            # an attack study with zero adversaries would silently
+            # measure a clean run (the silently-ignored-flag contract)
+            raise ValueError(
+                f"--adversary {self.adversary} with --adversary_frac 0 "
+                "injects nothing; pass --adversary_frac > 0 (fraction of "
+                "the client universe that is hostile)")
+        if self.adversary == "none" and self.adversary_frac > 0.0:
+            raise ValueError(
+                f"--adversary_frac {self.adversary_frac} without "
+                "--adversary selects clients that then do nothing; pass "
+                f"--adversary {{{','.join(ADVERSARY_KINDS[1:])}}}")
+        if self.adversary_scale <= 0:
+            raise ValueError(
+                f"--adversary_scale {self.adversary_scale} must be > 0 "
+                "(scale attack multiplier / noise sigma)")
+        if self.defense_clip_mult <= 0:
+            raise ValueError(
+                f"--defense_clip_mult {self.defense_clip_mult} must be > 0")
+        if self.defense_window < 1:
+            raise ValueError(
+                f"--defense_window {self.defense_window} must be >= 1")
+        if not 0.0 <= self.defense_trim_frac < 0.5:
+            raise ValueError(
+                f"--defense_trim_frac {self.defense_trim_frac} must be in "
+                "[0, 0.5): trimming half or more of the clients per side "
+                "leaves nothing to average")
+        if self.quarantine_backoff < 1:
+            raise ValueError(
+                f"--quarantine_backoff {self.quarantine_backoff} must be "
+                ">= 1 (rounds a struck client sits out before a retry)")
+        if self.quarantine_strikes < 1:
+            raise ValueError(
+                f"--quarantine_strikes {self.quarantine_strikes} must be "
+                ">= 1 (strikes before permanent ejection)")
         if self.profile_dir:
             # a bad window spec must fail at startup, not at round START
             from commefficient_tpu.telemetry.profiling import \
@@ -825,6 +926,44 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                    help="fraction of the round's worker slots that "
                         "actually participate (the rest are masked out "
                         "per cohort, deterministically)")
+    p.add_argument("--adversary", choices=ADVERSARY_KINDS, default="none",
+                   help="adversarial client injection: a deterministic "
+                        "--adversary_frac of the client universe (keyed "
+                        "off (seed, client_id)) label-flips, sign-flips, "
+                        "boosts, noises or NaN-poisons its uploads; works "
+                        "in sync and async rounds")
+    p.add_argument("--adversary_frac", type=float, default=0.0,
+                   help="fraction of the client universe that is "
+                        "adversarial (required > 0 with --adversary)")
+    p.add_argument("--adversary_scale", type=float, default=10.0,
+                   help="scale-attack multiplier / noise-attack sigma")
+    p.add_argument("--defense", choices=DEFENSES, default="none",
+                   help="robust aggregation in transmitted space: "
+                        "normclip = per-client update-norm clip to a "
+                        "rolling-median x --defense_clip_mult threshold; "
+                        "trim = per-coordinate trimmed-mean (single "
+                        "device)")
+    p.add_argument("--defense_clip_mult", type=float, default=3.0,
+                   help="normclip threshold = rolling median per-datum "
+                        "update norm x this multiplier")
+    p.add_argument("--defense_window", type=int, default=8,
+                   help="rounds of per-round median norms kept for the "
+                        "normclip rolling-median reference")
+    p.add_argument("--defense_trim_frac", type=float, default=0.1,
+                   help="trim: per-coordinate fraction of clients dropped "
+                        "at EACH extreme before averaging (in [0, 0.5))")
+    p.add_argument("--nonfinite_action", choices=NONFINITE_ACTIONS,
+                   default="abort",
+                   help="nonfinite per-client update: abort = the "
+                        "pre-existing all-or-nothing NaN abort; "
+                        "quarantine = zero the client out of the "
+                        "aggregate, bench it --quarantine_backoff rounds, "
+                        "eject after --quarantine_strikes strikes (a "
+                        "fully-nonfinite round still aborts)")
+    p.add_argument("--quarantine_backoff", type=int, default=8,
+                   help="rounds a struck client sits out before a retry")
+    p.add_argument("--quarantine_strikes", type=int, default=3,
+                   help="strikes before permanent ejection")
     p.add_argument("--remat", action="store_true", dest="do_remat")
     p.add_argument("--remat_policy", type=str, default="")
     p.add_argument("--lm_chunk", type=int, default=0)

@@ -257,6 +257,22 @@ class AsyncAggregator:
                              "cfg.async_agg=True (the cohort/commit steps "
                              "are only jitted then)")
         validate_async_combo(cfg)
+        sc_plan = getattr(scenario, "adversary", None)
+        rt_plan = getattr(runtime, "adversary_plan", None)
+        if sc_plan is not None and rt_plan is not None:
+            # the scenario's per-cohort adversary annotation
+            # (CohortFate.adversary) and the universe mask the jitted
+            # round actually applies are two AdversaryPlan instances
+            # that must describe the SAME assignment — a seed/frac
+            # mismatch would make the telemetry/ledger view silently
+            # diverge from the injected reality
+            a = (sc_plan.kind, sc_plan.frac, sc_plan.seed, sc_plan.scale)
+            b = (rt_plan.kind, rt_plan.frac, rt_plan.seed, rt_plan.scale)
+            if a != b:
+                raise ValueError(
+                    f"scenario adversary plan {a} disagrees with the "
+                    f"runtime's {b}: build both from the same FedConfig "
+                    "(make_scenario/make_adversary with matching seeds)")
         self.runtime = runtime
         self.scenario = scenario
         self.max_inflight = int(max_inflight if max_inflight is not None
@@ -305,7 +321,8 @@ class AsyncAggregator:
         tick = int(global_round)
         state = self._land_due(state, tick, lr, commits)
         mask_np = np.asarray(rnd.mask)
-        fate = (self.scenario.fate(tick, mask_np)
+        fate = (self.scenario.fate(tick, mask_np,
+                                   client_ids=rnd.client_ids)
                 if self.scenario is not None else None)
         if fate is not None and fate.dropped:
             # decided BEFORE the pool-full wait: a dropped cohort never
@@ -334,10 +351,22 @@ class AsyncAggregator:
             "upload_bytes": payload["upload_bytes"],
             "signals": None,
             "client_stats": payload["client_stats"],
+            # robustness channel (core/runtime._cohort_step): the
+            # defense-event scalars and the quarantine ledger's
+            # per-client finite flags ride the cohort payload — the
+            # driver's defense wiring is path-agnostic
+            "defense": payload["defense"],
+            "client_finite": payload["client_finite"],
             # host-resident effective participation for the ledger (the
             # scenario may have masked slots out of this cohort)
             "participation": (np.asarray(rnd.client_ids),
                               eff_mask.sum(axis=1)),
+            # the scenario's per-slot adversary annotation
+            # (CohortFate.adversary): the driver's defense event counts
+            # injections from the SAME draw the dispatch saw instead of
+            # re-deriving it against the ledger's view of the round
+            "adversary_slots": (fate.adversary if fate is not None
+                                else None),
         }
         return state, metrics, commits
 

@@ -44,6 +44,89 @@ class ClientOut(NamedTuple):
     stats: Optional[dict] = None
 
 
+# fold constant decorrelating the noise-attack draw from every other
+# consumer of the per-client round key (DP noise uses the key directly)
+_ADV_FOLD = 0xAD5E
+
+
+def flip_labels(batch: dict, adv: jax.Array, num_classes: int,
+                key: str = "target") -> dict:
+    """Label-flipping injection (data space): adversarial clients train
+    on ``(C-1) - y`` — the standard flip of the label-poisoning
+    literature. ``adv`` is the round's (W,) per-slot adversary mask;
+    applied on the full (W, B, ...) batch BEFORE the client compute, so
+    it works identically under the vmap, fused and fedavg paths."""
+    if key not in batch:
+        raise ValueError(
+            f"--adversary labelflip needs a {key!r} batch leaf (integer "
+            f"class labels); this batch has {sorted(batch)} — label "
+            "flipping is only defined for classification datasets")
+    t = batch[key]
+    advb = adv.reshape((-1,) + (1,) * (t.ndim - 1))
+    return {**batch, key: jnp.where(advb, (num_classes - 1) - t, t)}
+
+
+def inject_adversary(cfg: FedConfig, tx: jax.Array, adv: jax.Array,
+                     rngs: jax.Array,
+                     n_valid: Optional[jax.Array] = None) -> jax.Array:
+    """Update-space adversarial injection, applied to the per-client
+    transmitted quantities ``tx`` (W, ...) — dense gradients, sketch
+    tables or fedavg weight deltas alike (every kind below commutes with
+    the datum weighting already folded into ``tx``):
+
+    - signflip: upload x -1 (gradient-ascent poisoning);
+    - scale:    upload x adversary_scale (the boosted / model-replacement
+                attack);
+    - noise:    upload + adversary_scale * N(0, I) in transmitted space,
+                drawn per client from its round key (deterministic);
+    - nan:      upload all-NaN (the broken-client case
+                --nonfinite_action exists to survive).
+
+    A slot with no valid datums (``n_valid == 0``) uploads NOTHING — a
+    masked-out client (scenario participation, quarantine bench) has no
+    upload to corrupt, so injecting into its zero placeholder would
+    fabricate strikes for a client that never participated.
+    """
+    kind = cfg.adversary
+    if kind in ("none", "labelflip"):
+        return tx
+    if n_valid is not None:
+        adv = adv & (n_valid > 0)
+    advb = adv.reshape((-1,) + (1,) * (tx.ndim - 1))
+    if kind == "signflip":
+        return jnp.where(advb, -tx, tx)
+    if kind == "scale":
+        return jnp.where(advb, cfg.adversary_scale * tx, tx)
+    if kind == "noise":
+        noise = jax.vmap(
+            lambda r: jax.random.normal(jax.random.fold_in(r, _ADV_FOLD),
+                                        tx.shape[1:], tx.dtype))(rngs)
+        return jnp.where(advb, tx + cfg.adversary_scale * noise, tx)
+    if kind == "nan":
+        return jnp.where(advb, jnp.full_like(tx, jnp.nan), tx)
+    raise ValueError(f"unknown adversary kind {kind!r}")
+
+
+def quarantine_zero(tx: jax.Array, n_valid: jax.Array,
+                    results: Tuple[jax.Array, ...]
+                    ) -> Tuple[jax.Array, jax.Array,
+                               Tuple[jax.Array, ...], jax.Array]:
+    """Per-client nonfinite containment (``--nonfinite_action
+    quarantine``): a client whose transmitted quantity OR loss went
+    nonfinite is zeroed out of the round — its upload, its datum count
+    (so the aggregate normalization excludes it) and its metric
+    contributions (so the epoch accumulators stay finite). Returns
+    ``(tx', n_valid', results', finite)`` with ``finite`` the (W,) bool
+    flags the host-side QuarantineLedger consumes."""
+    flat = tx.reshape(tx.shape[0], -1)
+    fin = jnp.isfinite(flat).all(axis=1) & jnp.isfinite(results[0])
+    finb = fin.reshape((-1,) + (1,) * (tx.ndim - 1))
+    tx = jnp.where(finb, tx, 0.0)
+    n_valid = jnp.where(fin, n_valid, 0.0)
+    results = tuple(jnp.where(fin, r, 0.0) for r in results)
+    return tx, n_valid, results, fin
+
+
 def _num_microbatches(cfg: FedConfig, batch_size: int) -> Tuple[int, int]:
     if cfg.microbatch_size > 0:
         mb = min(batch_size, cfg.microbatch_size)

@@ -32,7 +32,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from commefficient_tpu.config import FedConfig
 from commefficient_tpu.core import client as client_lib
-from commefficient_tpu.core.server import (server_update,
+from commefficient_tpu.core.server import (robust_aggregate,
+                                           server_update,
+                                           validate_defense_combo,
                                            validate_mode_combo,
                                            validate_regimes)
 from commefficient_tpu.core.state import FedState
@@ -179,6 +181,39 @@ class FedRuntime:
             self.d_row_pad = cfg.grad_size
             self._rows_cols = False
         self._axis = self.shardings.axis if self.shardings else None
+        # --- robustness subsystem (adversary injection / robust
+        # aggregation / nonfinite quarantine). Everything below is gated
+        # at TRACE time on config flags that default off, so the round's
+        # HLO stays byte-identical to the pre-defense round when unused
+        # (identity-tested, same discipline as signals/client_stats).
+        validate_defense_combo(cfg, mesh=mesh, seq_axis=self._seq_axis)
+        self._adversary = cfg.adversary != "none"
+        # update-space kinds act on per-client transmitted quantities
+        # (vmap path); labelflip acts on the batch and stays
+        # fused-compatible
+        self._adv_inject = cfg.adversary in ("signflip", "scale",
+                                             "noise", "nan")
+        self._labelflip = cfg.adversary == "labelflip"
+        self._quarantine = cfg.nonfinite_action == "quarantine"
+        self._defense_ring = cfg.defense == "normclip"
+        self.adversary_plan = None
+        self._adv_universe = None
+        self._flip_classes = 0
+        if self._adversary:
+            from commefficient_tpu.data.scenarios import make_adversary
+            self.adversary_plan = make_adversary(cfg)
+            # the per-client assignment over the whole universe, baked
+            # into the jitted round as a tiny boolean constant — the
+            # device and the host (telemetry counts, the scenario
+            # engine's CohortFate.adversary) read the SAME draw
+            self._adv_universe = jnp.asarray(
+                self.adversary_plan.universe_mask(self.num_clients))
+            if self._labelflip:
+                from commefficient_tpu.config import num_classes_of_dataset
+                # validate_defense_combo already rejected non-classifiable
+                # datasets; resolve the flip arity here
+                self._flip_classes = num_classes_of_dataset(
+                    cfg.dataset_name)
         self.batch_size = (cfg.local_batch_size if cfg.local_batch_size > 0
                            else cfg.max_client_batch)
         self.cs = None
@@ -278,6 +313,13 @@ class FedRuntime:
         # --no_client_stats) nothing ever reads them, so the per-client
         # reductions are compiled out of the round entirely.
         self._client_stats = cfg.client_stats and cfg.telemetry
+        # defense-event scalars (clip fraction/mass, trim fraction,
+        # nonfinite count): tiny extra reductions, but still only
+        # computed when a telemetry stream exists to read them — the
+        # defense ARITHMETIC itself (clip/trim/zeroing) is never gated
+        # on telemetry, only its observability is
+        self._defense_stats = cfg.telemetry and (
+            cfg.defense != "none" or self._adversary or self._quarantine)
 
         loss_fn_val = loss_fn_val if loss_fn_val is not None else loss_fn_train
         # Fused client gradients: when nothing nonlinear happens per client
@@ -295,6 +337,12 @@ class FedRuntime:
             and not cfg.do_dp and cfg.max_grad_norm is None
             and not cfg.do_topk_down
             and self._seq_axis is None
+            # update-space injection, robust aggregation and per-client
+            # nonfinite flags all need the per-client transmitted
+            # quantities the fused accumulator sums away; labelflip is
+            # data-space and stays fused-eligible
+            and not self._adv_inject and cfg.defense == "none"
+            and not self._quarantine
             and n_iters * mb == self.batch_size)
         self._fused_fn = None
         # per-client GRADIENT stats only exist where a per-client
@@ -519,7 +567,77 @@ class FedRuntime:
             # like the server EF state it feeds
             async_buffer=maybe(server_tx, cfg.async_agg),
             async_buffer_n=maybe((), cfg.async_agg),
+            # normclip rolling reference: NaN = "round not yet seen"
+            # (nanmedian ignores it) — a zero-init would anchor the
+            # threshold at zero and clip everything on round 2
+            defense_ref=(jnp.full((cfg.defense_window,), jnp.nan,
+                                  jnp.float32)
+                         if self._defense_ring else None),
         )
+
+    # ------------------------------------------------- robustness tail
+
+    def _transmit_tail(self, tx, out, adv, ref, client_rngs):
+        """Shared per-client transmitted-space tail of the sync round's
+        and async cohort's client blocks: adversarial injection ->
+        nonfinite quarantine -> wire rounding -> robust (or plain-sum)
+        aggregation. MUST stay one function: the async K=1/M=1
+        bit-identity claim rides on both paths tracing exactly these
+        ops. ``tx`` is None on the fused path (the aggregate is already
+        accumulated; the robustness flags that need per-client uploads
+        force the vmap path) — then agg comes back None and the caller
+        keeps its own. Everything is compiled out at the flag defaults.
+        Returns ``(agg_or_None, results, n_valid, stats, client_finite,
+        defense_stats, cur_med)``."""
+        cfg = self.cfg
+        results, n_valid, stats = out.results, out.n_valid, out.stats
+        client_finite = cur_med = defense_stats = agg = None
+        if tx is not None:
+            if self._adv_inject:
+                tx = client_lib.inject_adversary(cfg, tx, adv,
+                                                 client_rngs,
+                                                 n_valid=n_valid)
+                if stats is not None:
+                    # the population stats must describe what each
+                    # client actually UPLOADED: recomputing tx_norm on
+                    # the post-injection transmit is what lets the
+                    # update_norm_outlier monitor rule see a boosted
+                    # client at all (the client step measured the
+                    # honest pre-injection value)
+                    flat = tx.reshape(tx.shape[0], -1)
+                    stats = {**stats, "tx_norm": jnp.sqrt(
+                        (flat * flat).sum(axis=1)).astype(jnp.float32)}
+            if self._quarantine:
+                tx, n_valid, results, client_finite = \
+                    client_lib.quarantine_zero(tx, n_valid, results)
+            td = self._table_dtype
+            wire = (td != jnp.float32 and not self._dense_preimage
+                    and cfg.mode == "sketch")
+            if wire and not self._defer_encode and tx.ndim == 3:
+                tx = tx.astype(td).astype(jnp.float32)
+            if cfg.defense != "none":
+                agg, cur_med, defense_stats = robust_aggregate(
+                    cfg, tx, n_valid, ref_thresh=ref,
+                    axis_name=self._axis)
+            else:
+                agg = tx.sum(axis=0)
+        return agg, results, n_valid, stats, client_finite, \
+            defense_stats, cur_med
+
+    def _defense_scalars(self, defense_stats, client_finite):
+        """The ``metrics['defense']`` dict (schema-v5 scalars; NaN = not
+        applicable for the configured defense/action, serialized null),
+        or None when the robustness observability is off."""
+        if not self._defense_stats:
+            return None
+        nan = jnp.full((), jnp.nan, jnp.float32)
+        d = (dict(defense_stats) if defense_stats is not None
+             else {"clip_frac": nan, "clip_thresh": nan,
+                   "clipped_mass": nan, "trim_frac": nan})
+        d["nonfinite_clients"] = (
+            (~client_finite).sum().astype(jnp.float32)
+            if client_finite is not None else nan)
+        return d
 
     # ------------------------------------------------------------- round step
 
@@ -586,8 +704,18 @@ class FedRuntime:
         has_vel = vel_rows is not None
         has_err = err_rows is not None
 
+        # ---- robustness inputs: per-slot adversary assignment (the
+        # baked universe constant indexed by this round's client ids)
+        # and the normclip rolling-median reference (NaN while the ring
+        # is cold — robust_aggregate falls back to the round's own
+        # median). Both None (and compiled out) when the flags are off.
+        adv_slot = (self._adv_universe[client_ids]
+                    if self._adversary else None)
+        ref_thresh = (jnp.nanmedian(state.defense_ref)
+                      if self._defense_ring else None)
+
         def client_block(used_weights, batch, mask, vel_rows, err_rows,
-                         client_rngs, lr, cs):
+                         client_rngs, lr, adv, ref, cs):
             if self._rows_cols and self._axis is not None:
                 # home->compute layout: each device holds a (W, d_row_pad/n)
                 # column slice of all round rows; ONE all_to_all turns it
@@ -607,6 +735,13 @@ class FedRuntime:
                 used = used_weights[: cfg.grad_size]
             else:
                 used = used_weights
+            if self._labelflip:
+                # data-space injection: adversarial clients train on
+                # flipped labels (core/client.flip_labels) — applied on
+                # the whole (W, B) batch so every client path (vmap,
+                # fused, fedavg) sees it identically
+                batch = client_lib.flip_labels(batch, adv,
+                                               self._flip_classes)
             # --sketch_dtype bfloat16 wire (see config.py): per-client
             # table uploads round to bf16 before the server's accumulation
             # (non-deferred encode only — deferred encode has no
@@ -616,6 +751,7 @@ class FedRuntime:
             td = self._table_dtype
             wire = (td != jnp.float32 and not self._dense_preimage
                     and cfg.mode == "sketch")
+            tx = None
             if cfg.mode == "fedavg":
                 # fedavg applies the LR on the CLIENT against true-d
                 # weights; a per-param vector arrives mesh-padded for the
@@ -625,11 +761,13 @@ class FedRuntime:
                     self._client_fn,
                     in_axes=(params_axis, 0, 0, None, 0))(
                         used, batch, mask, lr_c, client_rngs)
-                agg = out.transmit.sum(axis=0)
+                tx = out.transmit
             elif self._fused:
                 # jointly-computed round gradient (make_fused_grad): ONE
                 # (d,) accumulator over all local clients' microbatches —
-                # no per-client (W, d) gradient materialization
+                # no per-client (W, d) gradient materialization (the
+                # robustness flags that need per-client uploads force
+                # the vmap path, see __init__)
                 agg, f_results, f_nvalid = self._fused_fn(used, batch, mask)
                 out = client_lib.ClientOut(None, None, None, f_results,
                                            f_nvalid)
@@ -642,9 +780,15 @@ class FedRuntime:
                         used, batch, mask, vel_rows, err_rows,
                         client_rngs, cs)
                 tx = out.transmit
-                if wire and not self._defer_encode and tx.ndim == 3:
-                    tx = tx.astype(td).astype(jnp.float32)
-                agg = tx.sum(axis=0)
+            # ---- shared per-client transmitted-space tail (injection
+            # -> quarantine -> wire -> robust aggregation); compiled out
+            # entirely at the flag defaults — the off-path ops and their
+            # order stay byte-identical to the pre-defense round
+            t_agg, results, n_valid, stats, client_finite, \
+                defense_stats, cur_med = self._transmit_tail(
+                    tx, out, adv, ref, client_rngs)
+            if t_agg is not None:
+                agg = t_agg
             sig_dense = None
             if self._defer_encode and not self._dense_preimage:
                 if self._signals_dense_cap:
@@ -656,7 +800,7 @@ class FedRuntime:
                 agg = cs.encode(agg)
             if wire and self._axis is None and agg.ndim == 2:
                 agg = agg.astype(td).astype(jnp.float32)
-            n_total = out.n_valid.sum()
+            n_total = n_valid.sum()
             if self._axis is not None:
                 # the aggregation spans every mesh axis: clients sum across
                 # the clients axis, and (in seq mode) each client's partial
@@ -712,6 +856,18 @@ class FedRuntime:
                 # replicates over seq) — sum over clients only
                 n_total = lax.psum(n_total, self._axis)
             vel_out, err_out = out.velocity, out.error
+            if client_finite is not None:
+                # a struck client's persistent local rows must not absorb
+                # its nonfinite round — keep the previous rows (still in
+                # the compute layout here, matching vel_out/err_out)
+                if vel_out is not None:
+                    finb = client_finite.reshape(
+                        (-1,) + (1,) * (vel_out.ndim - 1))
+                    vel_out = jnp.where(finb, vel_out, vel_rows)
+                if err_out is not None:
+                    finb = client_finite.reshape(
+                        (-1,) + (1,) * (err_out.ndim - 1))
+                    err_out = jnp.where(finb, err_out, err_rows)
             if self._rows_cols and self._axis is not None:
                 # compute->home layout: the reverse all_to_all routes each
                 # updated row's columns back to their owning shards
@@ -724,8 +880,9 @@ class FedRuntime:
                     vel_out = rows_to_home(vel_out)
                 if err_out is not None:
                     err_out = rows_to_home(err_out)
-            return agg, n_total, vel_out, err_out, out.results, \
-                out.n_valid, sig_dense, out.stats
+            return agg, n_total, vel_out, err_out, results, \
+                n_valid, sig_dense, stats, client_finite, \
+                defense_stats, cur_med
 
         if self._axis is not None:
             ax = self._axis
@@ -746,6 +903,8 @@ class FedRuntime:
                 row_spec if has_err else None,
                 row,
                 P(),
+                row if self._adversary else None,      # adv slot mask
+                P() if self._defense_ring else None,   # normclip reference
                 jax.tree.map(lambda _: P(), cs),
             )
             # dense modes leave the block as a reduce_scattered shard of
@@ -764,6 +923,13 @@ class FedRuntime:
                 # per-client quantity (telemetry/clients.py)
                 ({k: row for k in CLIENT_GRAD_KEYS}
                  if self._client_grad_stats else None),
+                # per-client finite flags (quarantine)
+                row if self._quarantine else None,
+                # defense scalars leave the block psum'd/replicated
+                ({k: P() for k in ("clip_frac", "clip_thresh",
+                                   "clipped_mass", "trim_frac")}
+                 if cfg.defense != "none" else None),
+                P() if self._defense_ring else None,   # cur_med
             )
             # check_vma off: the client step's scan carries start as
             # replicated zeros and become device-varying on the first
@@ -773,9 +939,9 @@ class FedRuntime:
                                      check_vma=False)
 
         agg, n_total, vel_new, err_new, results, n_valid, sig_dense, \
-            client_grad_stats = client_block(
-                used_weights, batch, mask, vel_rows, err_rows,
-                client_rngs, lr, cs)
+            client_grad_stats, client_finite, defense_stats, cur_med = \
+            client_block(used_weights, batch, mask, vel_rows, err_rows,
+                         client_rngs, lr, adv_slot, ref_thresh, cs)
         out = client_lib.ClientOut(None, vel_new, err_new, results, n_valid,
                                    client_grad_stats)
         total = jnp.maximum(n_total, 1.0)
@@ -881,10 +1047,32 @@ class FedRuntime:
         # non-finite (fused isfinite+reduce; a NaN gradient does not always
         # survive the top-k select into the update, and the reference's
         # host check is on the loss, cv_train.py:222-224)
-        bad = (~jnp.isfinite(update).all() | ~jnp.isfinite(agg).all()
-               | ~jnp.isfinite(out.results[0]).all())
+        bad = ~jnp.isfinite(update).all() | ~jnp.isfinite(agg).all()
+        if self._quarantine:
+            # per-client nonfinites were zeroed OUT of the aggregate in
+            # the client block (their losses too) — only a round with no
+            # finite DATA-CARRYING client left, or nonfinite SERVER
+            # state, still aborts. A nonfinite flag can only come from a
+            # live slot (benched/masked placeholders upload finite
+            # zeros), so "fully-nonfinite round" == some client went
+            # nonfinite AND no finite client with data remains
+            # (n_valid is post-zeroing: > 0 iff live AND finite)
+            bad = bad | ((~client_finite).any()
+                         & ~(out.n_valid > 0).any())
+        else:
+            bad = bad | ~jnp.isfinite(out.results[0]).all()
         nan_round = jnp.where((state.nan_round < 0) & bad, state.step,
                               state.nan_round)
+
+        # normclip rolling reference: this round's median per-datum norm
+        # enters the ring AFTER the round used the PAST medians — the
+        # attack round cannot vouch for its own normality
+        defense_ref = state.defense_ref
+        if self._defense_ring:
+            defense_ref = state.defense_ref.at[
+                jnp.mod(state.step, cfg.defense_window)].set(cur_med)
+
+        defense = self._defense_scalars(defense_stats, client_finite)
 
         new_state = FedState(
             ps_weights=ps_weights,
@@ -904,6 +1092,7 @@ class FedRuntime:
             # buffer (the two paths are mutually exclusive per config)
             async_buffer=state.async_buffer,
             async_buffer_n=state.async_buffer_n,
+            defense_ref=defense_ref,
         )
         metrics = {
             "results": out.results,          # tuple of (num_workers,) arrays
@@ -912,6 +1101,10 @@ class FedRuntime:
             "upload_bytes": upload_bytes,
             "signals": signals,              # dict of scalars, or None
             "client_stats": client_stats,    # quantile summaries, or None
+            "defense": defense,              # dict of scalars, or None
+            # (W,) bool, quarantine mode only: the host-side ledger's
+            # per-round feed (False = zeroed out of this aggregate)
+            "client_finite": client_finite,
         }
         return new_state, metrics
 
@@ -998,19 +1191,29 @@ class FedRuntime:
             client_last_round = state.client_last_round.at[client_ids].set(
                 state.step)
 
-        def client_block(used_weights, batch, mask, client_rngs, lr, cs):
+        adv_slot = (self._adv_universe[client_ids]
+                    if self._adversary else None)
+        ref_thresh = (jnp.nanmedian(state.defense_ref)
+                      if self._defense_ring else None)
+
+        def client_block(used_weights, batch, mask, client_rngs, lr, adv,
+                         ref, cs):
             # validate_async_combo guarantees no vel/err rows and no
             # topk_down here — otherwise byte-for-byte the sync block
             used = used_weights[: cfg.grad_size]
+            if self._labelflip:
+                batch = client_lib.flip_labels(batch, adv,
+                                               self._flip_classes)
             td = self._table_dtype
             wire = (td != jnp.float32 and not self._dense_preimage
                     and cfg.mode == "sketch")
+            tx = None
             if cfg.mode == "fedavg":
                 lr_c = lr[: cfg.grad_size] if lr.ndim == 1 else lr
                 out = jax.vmap(
                     self._client_fn, in_axes=(None, 0, 0, None, 0))(
                         used, batch, mask, lr_c, client_rngs)
-                agg = out.transmit.sum(axis=0)
+                tx = out.transmit
             elif self._fused:
                 agg, f_results, f_nvalid = self._fused_fn(used, batch, mask)
                 out = client_lib.ClientOut(None, None, None, f_results,
@@ -1021,14 +1224,20 @@ class FedRuntime:
                     in_axes=(None, 0, 0, None, None, 0, None))(
                         used, batch, mask, None, None, client_rngs, cs)
                 tx = out.transmit
-                if wire and not self._defer_encode and tx.ndim == 3:
-                    tx = tx.astype(td).astype(jnp.float32)
-                agg = tx.sum(axis=0)
+            # the SAME transmitted-space tail as the sync block
+            # (adversarial fates act at COHORT COMPUTE, which both paths
+            # share — the reason injection works with and without
+            # --async_agg)
+            t_agg, results, n_valid, stats, client_finite, \
+                defense_stats, cur_med = self._transmit_tail(
+                    tx, out, adv, ref, client_rngs)
+            if t_agg is not None:
+                agg = t_agg
             if self._defer_encode and not self._dense_preimage:
                 agg = cs.encode(agg)
             if wire and self._axis is None and agg.ndim == 2:
                 agg = agg.astype(td).astype(jnp.float32)
-            n_total = out.n_valid.sum()
+            n_total = n_valid.sum()
             if self._axis is not None:
                 all_axes = tuple(self.mesh.axis_names)
                 if agg.ndim == 1:
@@ -1045,7 +1254,8 @@ class FedRuntime:
                 if self._seq_axis is not None:
                     agg = agg / self._seq_grad_scale
                 n_total = lax.psum(n_total, self._axis)
-            return agg, n_total, out.results, out.n_valid, out.stats
+            return agg, n_total, results, n_valid, stats, \
+                client_finite, defense_stats, cur_med
 
         if self._axis is not None:
             ax = self._axis
@@ -1056,6 +1266,8 @@ class FedRuntime:
             else:
                 batch_specs = jax.tree.map(lambda _: row, batch)
             in_specs = (P(), batch_specs, row, row, P(),
+                        row if self._adversary else None,
+                        P() if self._defense_ring else None,
                         jax.tree.map(lambda _: P(), cs))
             dense_agg_spec = P(tuple(self.mesh.axis_names))
             out_specs = (
@@ -1065,13 +1277,20 @@ class FedRuntime:
                 row,
                 ({k: row for k in CLIENT_GRAD_KEYS}
                  if self._client_grad_stats else None),
+                row if self._quarantine else None,
+                ({k: P() for k in ("clip_frac", "clip_thresh",
+                                   "clipped_mass", "trim_frac")}
+                 if cfg.defense != "none" else None),
+                P() if self._defense_ring else None,
             )
             client_block = shard_map(client_block, mesh=self.mesh,
                                      in_specs=in_specs, out_specs=out_specs,
                                      check_vma=False)
 
-        agg, n_total, results, n_valid, grad_stats = client_block(
-            state.ps_weights, batch, mask, client_rngs, lr, cs)
+        agg, n_total, results, n_valid, grad_stats, client_finite, \
+            defense_stats, cur_med = client_block(
+                state.ps_weights, batch, mask, client_rngs, lr, adv_slot,
+                ref_thresh, cs)
 
         client_stats = None
         if self._client_stats:
@@ -1095,12 +1314,30 @@ class FedRuntime:
 
         # dispatch-side divergence detection: a poisoned cohort sum must
         # be flagged before it can merge into the buffer
-        bad = ~jnp.isfinite(agg).all() | ~jnp.isfinite(results[0]).all()
+        bad = ~jnp.isfinite(agg).all()
+        if self._quarantine:
+            # same "fully-nonfinite" semantics as the sync round: a
+            # benched/masked placeholder slot never vouches for a cohort
+            # whose every live upload diverged
+            bad = bad | ((~client_finite).any() & ~(n_valid > 0).any())
+        else:
+            bad = bad | ~jnp.isfinite(results[0]).all()
         nan_round = jnp.where((state.nan_round < 0) & bad, state.step,
                               state.nan_round)
 
+        defense_ref = state.defense_ref
+        if self._defense_ring:
+            # at cohort (dispatch) granularity the ring keys off the
+            # server version — commits between dispatches share a slot,
+            # which only shortens the effective window, never corrupts it
+            defense_ref = state.defense_ref.at[
+                jnp.mod(state.step, cfg.defense_window)].set(cur_med)
+
+        defense = self._defense_scalars(defense_stats, client_finite)
+
         new_state = state.replace(rng=rng, client_last_round=client_last_round,
-                                  nan_round=nan_round)
+                                  nan_round=nan_round,
+                                  defense_ref=defense_ref)
         payload = {
             "sum": agg,                  # UNNORMALIZED weighted client sum
             "n_total": n_total,          # datum count of this cohort
@@ -1109,6 +1346,8 @@ class FedRuntime:
             "download_bytes": download_bytes,
             "upload_bytes": upload_bytes,
             "client_stats": client_stats,
+            "defense": defense,
+            "client_finite": client_finite,
         }
         return new_state, payload
 

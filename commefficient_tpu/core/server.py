@@ -107,6 +107,144 @@ def validate_regimes(cfg: FedConfig) -> None:
         print(f"WARNING: {w}", file=sys.stderr)
 
 
+def validate_defense_combo(cfg: FedConfig, mesh=None,
+                           seq_axis=None) -> None:
+    """Reject adversary/defense/quarantine configurations that cannot be
+    implemented soundly on this topology — the fail-fast companion of
+    validate_mode_combo for the robustness subsystem."""
+    robust = (cfg.defense != "none" or cfg.adversary != "none"
+              or cfg.nonfinite_action != "abort")
+    if not robust:
+        return
+    if seq_axis is not None:
+        # inside a seq-sharded round each shard holds only its PARTIAL
+        # per-client gradient: per-client norms/finite flags/injections
+        # computed per shard would describe partials, not clients (the
+        # same reason max_grad_norm is forbidden with a seq axis)
+        raise ValueError(
+            "--adversary/--defense/--nonfinite_action quarantine are "
+            "unsupported with a seq mesh axis: they act on PER-CLIENT "
+            "transmitted quantities, and a seq-sharded round only ever "
+            "holds per-shard partials of them")
+    if cfg.defense == "trim" and mesh is not None:
+        raise ValueError(
+            "--defense trim needs the per-coordinate cross-client sort, "
+            "which requires every client's full transmitted vector on "
+            "one device — unavailable on a mesh (the client axis is "
+            "sharded). Use --defense normclip on a mesh (its cross-shard "
+            "cost is one W-sized norm all-gather), or drop the mesh.")
+    if cfg.adversary == "labelflip":
+        from commefficient_tpu.config import FED_DATASETS
+        n_cls = FED_DATASETS.get(cfg.dataset_name, 0)
+        if n_cls < 2:
+            raise ValueError(
+                f"--adversary labelflip needs a classification dataset "
+                f"with >= 2 classes; {cfg.dataset_name!r} has "
+                f"{n_cls if n_cls > 0 else 'no fixed class count'} — use "
+                "signflip/scale/noise/nan for update-space attacks "
+                "instead")
+
+
+def robust_aggregate(cfg: FedConfig, tx: jax.Array, n_valid: jax.Array,
+                     ref_thresh: Optional[jax.Array] = None,
+                     axis_name: Optional[str] = None):
+    """Robust aggregation of the per-client transmitted quantities
+    (``--defense``), traced inside the jitted round's client block.
+
+    ``tx`` is (W, ...) — each client's datum-weighted upload (dense
+    gradient x n_c, sketch table x n_c, or fedavg delta x n_c);
+    ``n_valid`` its (W,) datum counts. All statistics are over the
+    PER-DATUM update ``tx_i / n_i`` so differently-sized clients are
+    commensurable. Returns ``(agg, cur_med, stats)`` where ``agg``
+    replaces the plain ``tx.sum(axis=0)``, ``cur_med`` is this round's
+    median per-datum norm (the rolling-reference feed, normclip only —
+    None otherwise) and ``stats`` holds the defense-event scalars.
+
+    - **normclip** (Sun et al. 2019): clip each client's per-datum norm
+      to ``ref x defense_clip_mult`` where ``ref`` is the rolling median
+      of past rounds' median norms (``ref_thresh``; NaN on the first
+      round falls back to THIS round's median — itself robust to a <50%
+      adversarial cohort). An l2 clip is a rescaling, so it commutes
+      with the linear sketch: clipping the dense gradient then encoding
+      equals encoding then scaling the table by the same factor
+      (pinned by tests/test_defense.py). On a mesh the per-shard norms
+      all-gather over ``axis_name`` (W floats) so every shard clips
+      against the same global median.
+    - **trim** (Yin et al. 2018): per-coordinate trimmed mean — sort
+      each coordinate across clients, drop ``floor(trim_frac * V)`` at
+      each extreme (V = clients that carried data this round, NOT the
+      slot count W: benched/masked placeholders hold no vote, see the
+      in-body comment), average the rest uniformly, and rescale by the
+      round's datum total so the caller's ``agg / n_total``
+      normalization yields the trimmed mean itself. Single device only
+      (validate_defense_combo).
+    """
+    from jax import lax
+
+    W = tx.shape[0]
+    denom = jnp.maximum(n_valid, 1.0)
+    denb = denom.reshape((W,) + (1,) * (tx.ndim - 1))
+    valid = n_valid > 0
+
+    if cfg.defense == "trim":
+        assert axis_name is None, "trim is single-device (validated)"
+        # zero-datum slots (quarantine-benched, participation-masked)
+        # carry NO vote: counting their 0/1 = 0 placeholder updates as
+        # honest clients would silently dilute the trimmed mean toward
+        # zero (with 2 live clients in an 8-slot round the defended
+        # update would shrink 4x). Validity is PER-SLOT, so every
+        # coordinate has the same count V of real values — push the
+        # invalid slots to +inf, sort, and average ranks [t, V-t) with
+        # a traced rank mask (t stays a fraction of the LIVE cohort).
+        # A nonfinite upload from a live slot sorts last too: with
+        # t >= 1 the trim absorbs it (that IS the defense); at t == 0
+        # it poisons the mean and the nan_round abort fires as before.
+        validb = valid.reshape((W,) + (1,) * (tx.ndim - 1))
+        V = valid.sum()
+        t = jnp.floor(cfg.defense_trim_frac * V).astype(jnp.int32)
+        u = jnp.where(validb, tx / denb, jnp.inf)
+        s = jnp.sort(u, axis=0)             # per-coordinate order stats
+        rank = jnp.arange(W).reshape((W,) + (1,) * (tx.ndim - 1))
+        keep = (rank >= t) & (rank < V - t)
+        n_kept = jnp.maximum(V - 2 * t, 1)
+        core_mean = jnp.where(keep, s, 0.0).sum(axis=0) / n_kept
+        agg = core_mean * n_valid.sum()
+        nan = jnp.full((), jnp.nan, jnp.float32)
+        stats = {"clip_frac": nan, "clip_thresh": nan, "clipped_mass": nan,
+                 "trim_frac": (2.0 * t / jnp.maximum(V, 1)
+                               ).astype(jnp.float32)}
+        return agg, None, stats
+
+    assert cfg.defense == "normclip", cfg.defense
+    flat = tx.reshape(W, -1)
+    norms = jnp.sqrt((flat * flat).sum(axis=1)).astype(jnp.float32) / denom
+    usable = valid & jnp.isfinite(norms)
+    med_in = jnp.where(usable, norms, jnp.nan)
+    if axis_name is not None:
+        med_in = lax.all_gather(med_in, axis_name, tiled=True)
+    cur_med = jnp.nanmedian(med_in).astype(jnp.float32)
+    ref = jnp.where(jnp.isnan(ref_thresh), cur_med, ref_thresh)
+    thresh = jnp.float32(cfg.defense_clip_mult) * ref
+    factors = jnp.minimum(1.0, thresh / jnp.maximum(norms, 1e-12))
+    factors = jnp.where(usable, factors, 1.0)
+    agg = (tx * factors.reshape((W,) + (1,) * (tx.ndim - 1))).sum(axis=0)
+    n_clipped = ((factors < 1.0) & usable).sum().astype(jnp.float32)
+    removed_sq = jnp.where(
+        usable, ((1.0 - factors) * norms * denom) ** 2, 0.0).sum()
+    n_part = usable.sum().astype(jnp.float32)
+    if axis_name is not None:
+        n_clipped = lax.psum(n_clipped, axis_name)
+        removed_sq = lax.psum(removed_sq, axis_name)
+        n_part = lax.psum(n_part, axis_name)
+    stats = {
+        "clip_frac": n_clipped / jnp.maximum(n_part, 1.0),
+        "clip_thresh": thresh,
+        "clipped_mass": jnp.sqrt(removed_sq).astype(jnp.float32),
+        "trim_frac": jnp.full((), jnp.nan, jnp.float32),
+    }
+    return agg, cur_med, stats
+
+
 def validate_mode_combo(cfg: FedConfig) -> None:
     """Reject illegal mode/error/momentum combinations up front.
 

@@ -73,3 +73,13 @@ class FedState:
     # drivers loudly zero it, see async_agg.reconcile_resumed_state.
     async_buffer: Optional[jax.Array] = None       # transmitted shape
     async_buffer_n: Optional[jax.Array] = None     # () fp32
+    # --defense normclip rolling reference (core/server.robust_aggregate):
+    # a (defense_window,) NaN-initialized ring of past rounds' median
+    # per-datum update norms. The clip threshold is
+    # nanmedian(ring) x defense_clip_mult — median-of-medians, so one
+    # boosted round cannot drag the envelope after it, and NaN slots
+    # (rounds not yet seen) are simply ignored. Replicated on a mesh
+    # (a window of scalars); checkpoints written before it existed
+    # restore None and the driver re-initializes it to NaN — the
+    # reference (not the run) restarts cold, see cv_train.
+    defense_ref: Optional[jax.Array] = None        # (defense_window,) fp32

@@ -33,9 +33,10 @@ from commefficient_tpu.data import (
     transforms_for,
 )
 from commefficient_tpu.data.device_store import make_device_store
+from commefficient_tpu.data.fed_sampler import mask_blocked
 from commefficient_tpu.losses import make_cv_loss
 from commefficient_tpu.telemetry import (ProfilerWindow, UtilizationTracker,
-                                         tracing)
+                                         signals_to_host, tracing)
 from commefficient_tpu.telemetry import maybe_create as make_telemetry
 from commefficient_tpu.telemetry.clients import (ParticipationLedger,
                                                  client_stats_to_host)
@@ -187,6 +188,20 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
                 # drop it so the state matches this runtime's template
                 restored = restored.replace(sig_Vvelocity=None,
                                             sig_Verror=None)
+            # --defense normclip rolling reference: a checkpoint written
+            # before it existed (or with a different window) re-inits it
+            # to NaN — the clip reference (not the run) restarts cold,
+            # falling back to the resumed rounds' own medians; a ring
+            # resumed into a run without normclip is dropped
+            ring_n = (runtime.cfg.defense_window
+                      if runtime._defense_ring else None)
+            cur_ring = restored.defense_ref
+            if ring_n is not None and (cur_ring is None
+                                       or cur_ring.shape[0] != ring_n):
+                restored = restored.replace(defense_ref=jnp.full(
+                    (ring_n,), jnp.nan, jnp.float32))
+            elif ring_n is None and cur_ring is not None:
+                restored = restored.replace(defense_ref=None)
             # async buffer reconciliation (core/async_agg.py): a missing
             # buffer initializes EMPTY, a NON-EMPTY one (mid-epoch
             # postmortem) is LOUDLY restarted — the epoch replays from
@@ -343,6 +358,25 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
               f"{async_agg.discount} staleness discount"
               + ("" if async_agg.scenario is None
                  else f", scenario={cfg.scenario}"))
+    # robustness subsystem (core/runtime.py does the in-round work; this
+    # loop owns the host half): the quarantine ledger benches/ejects
+    # clients whose uploads went nonfinite — the device already zeroed
+    # them out of the aggregate, this just stops re-dispatching them —
+    # and the schema-v5 `defense` event reports what the defense did
+    qledger = None
+    if cfg.nonfinite_action == "quarantine":
+        from commefficient_tpu.core.quarantine import QuarantineLedger
+        qledger = QuarantineLedger(backoff=cfg.quarantine_backoff,
+                                   strikes=cfg.quarantine_strikes)
+    adv_plan = getattr(runtime, "adversary_plan", None)
+    defense_on = (cfg.defense != "none" or cfg.adversary != "none"
+                  or cfg.nonfinite_action == "quarantine")
+    if cfg.adversary != "none" and adv_plan is not None:
+        n_adv = int(adv_plan.universe_mask(train_ds.num_clients).sum())
+        print(f"adversary injection: {cfg.adversary} on {n_adv}/"
+              f"{train_ds.num_clients} clients "
+              f"(frac {cfg.adversary_frac}), defense={cfg.defense}, "
+              f"nonfinite_action={cfg.nonfinite_action}")
     # device-resident data path: upload the dataset once, gather + augment
     # each round's batch on device, accumulate metrics on device, and fetch
     # once per epoch — a host<->device transfer costs ~170 ms latency on
@@ -419,6 +453,11 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
             for item in pipe:
                 rnd, batch = item.rnd, item.batch
                 global_round = item.global_round
+                if qledger is not None:
+                    # bench quarantined clients at DISPATCH time (the
+                    # prefetched Round is shared state — never mutated):
+                    # their slots keep static shapes, contribute no data
+                    rnd = mask_blocked(rnd, qledger.blocked(global_round))
                 t_loop = time.perf_counter()
                 # host_s = what the loop WAITED for this round's input
                 # (inline: the fetch itself; pipelined: the queue wait —
@@ -480,6 +519,52 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                         obs_ids = rnd.client_ids
                         obs_n = np.asarray(rnd.mask).sum(axis=1)
                     ledger.observe(global_round, obs_ids, obs_n)
+                if qledger is not None and metrics is not None \
+                        and metrics.get("client_finite") is not None:
+                    # quarantine strikes: ONE (W,)-bool fetch per round —
+                    # the documented host-sync price of quarantine mode
+                    # (the device zeroing already protected the round)
+                    fin = np.asarray(metrics["client_finite"])
+                    struck = qledger.observe(
+                        global_round, np.asarray(rnd.client_ids), fin)
+                    for cid in struck:
+                        if cid in qledger.ejected:
+                            what = "EJECTED (strikes exhausted)"
+                        else:
+                            what = (f"benched {cfg.quarantine_backoff} "
+                                    f"rounds (strike "
+                                    f"{qledger.strikes[cid]}/"
+                                    f"{qledger.max_strikes})")
+                        print(f"QUARANTINE: client {cid} uploaded a "
+                              f"nonfinite update at round {global_round}; "
+                              f"{what}", file=sys.stderr)
+                    if len(qledger.ejected) >= train_ds.num_clients:
+                        # every client permanently ejected: no data
+                        # source remains, and letting the loop keep
+                        # dispatching fully-masked rounds would burn the
+                        # whole budget on a silently "successful" run
+                        print("QUARANTINE ABORT: all "
+                              f"{train_ds.num_clients} clients are "
+                              "permanently ejected (nonfinite strikes "
+                              "exhausted) — no data remains, TERMINATING")
+                        prof.finalize(lambda: jax.block_until_ready(
+                            state.ps_weights))
+                        if telemetry is not None:
+                            telemetry.alert_event(
+                                rnd=global_round,
+                                rule="quarantine_exhausted",
+                                severity="critical",
+                                metric="defense.ejected",
+                                value=float(len(qledger.ejected)),
+                                action=cfg.alert_action)
+                            telemetry.span_event(tracer)
+                            telemetry.write_summary(
+                                aborted=True, n_rounds=rounds_run + 1,
+                                total_download_mib=total_download_mb,
+                                total_upload_mib=total_upload_mb,
+                                final=telemetry.last_epoch)
+                            telemetry.fsync()
+                        return state, None
                 if record:
                     with tracing.span("telemetry_emit"):
                         res = [np.asarray(r) for r in metrics["results"]]
@@ -511,8 +596,6 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                         if metrics.get("signals"):
                             # compression-signal health, same cadence / same
                             # host sync as the round record (signals.py)
-                            from commefficient_tpu.telemetry import \
-                                signals_to_host
                             telemetry.signals_event(
                                 rnd=global_round, mode=cfg.mode,
                                 signals=signals_to_host(metrics["signals"]),
@@ -540,6 +623,43 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                                     rnd.client_ids),
                                 participation=ledger.snapshot(
                                     global_round))
+                        if defense_on:
+                            # schema-v5 defense record: device scalars
+                            # (already synced with the metrics above) +
+                            # the quarantine ledger + injected counts
+                            dd = metrics.get("defense")
+                            inj = None
+                            if adv_plan is not None:
+                                # a hostile slot only INJECTS if it
+                                # carries data: inject_adversary skips
+                                # zero-datum slots (benched/participation-
+                                # masked clients upload nothing), so the
+                                # count must too or the stream reports
+                                # injection from clients that sat out
+                                if async_agg is not None:
+                                    ids_a, n_a = metrics["participation"]
+                                    slots = metrics.get("adversary_slots")
+                                    if slots is None:
+                                        slots = adv_plan.slot_mask(
+                                            np.asarray(ids_a))
+                                    live = np.asarray(n_a) > 0
+                                else:
+                                    slots = adv_plan.slot_mask(
+                                        np.asarray(rnd.client_ids))
+                                    live = np.asarray(rnd.mask).any(axis=1)
+                                inj = {cfg.adversary: int(
+                                    (np.asarray(slots) & live).sum())}
+                            telemetry.defense_event(
+                                rnd=global_round,
+                                defense=cfg.defense,
+                                adversary=cfg.adversary,
+                                nonfinite_action=cfg.nonfinite_action,
+                                device=(signals_to_host(dd) if dd
+                                        else {}),
+                                quarantine=(qledger.snapshot(global_round)
+                                            if qledger is not None
+                                            else None),
+                                injected=inj)
                         # MFU/starvation over the window since the last
                         # record, and the window's spans — the tail of
                         # this round's trace lands in the next drain

@@ -43,6 +43,26 @@ class Round(NamedTuple):
     mask: np.ndarray        # (num_workers, B)
 
 
+def mask_blocked(rnd: Round, blocked) -> Round:
+    """Mask quarantined clients out of a sampled round.
+
+    ``blocked`` is a set/container of client ids currently benched by the
+    quarantine ledger (core/quarantine.py). Their slots keep the static
+    shapes the jitted round needs but contribute no data (mask all-False
+    — the same slot-masking convention the scenario engine's partial
+    participation uses). The original Round is never mutated: prefetched
+    rounds (core/pipeline.py) are shared state, and the block decision is
+    taken at DISPATCH time against the ledger's current view.
+    """
+    if not blocked:
+        return rnd
+    hit = np.fromiter((int(c) in blocked for c in rnd.client_ids),
+                      dtype=bool, count=len(rnd.client_ids))
+    if not hit.any():
+        return rnd
+    return rnd._replace(mask=rnd.mask & ~hit[:, None])
+
+
 class FedSampler:
     def __init__(self, data_per_client: np.ndarray, num_workers: int,
                  local_batch_size: int, max_client_batch: int = 512,

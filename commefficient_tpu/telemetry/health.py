@@ -51,7 +51,7 @@ from typing import Any, Dict, List, Optional
 SEVERITIES = ("info", "warn", "critical")
 # event kinds RunTelemetry forwards to an attached monitor
 MONITORED_KINDS = ("round", "signals", "utilization", "client_stats",
-                   "async_round")
+                   "async_round", "defense")
 
 # The rule table: each rule watches ONE field of ONE event kind.
 # kind="z" fires on a robust z-score breach of the rolling history
@@ -94,7 +94,25 @@ RULES = (
          kind="z", direction="high", severity="warn"),
     dict(name="staleness_spike", event="async_round",
          field="staleness_max", kind="z", direction="high",
-         severity="info"),
+         severity="info", mad_floor_abs=0.5),
+    # robustness subsystem (schema v5, core/runtime.py defense events +
+    # the client_stats tx_norm quantiles): a client whose transmitted
+    # update norm leaves the population envelope is the boosted/scale-
+    # attack signature BEFORE any defense decision; quarantine count
+    # growth is the broken-fleet signature. The count-like quarantined
+    # metric sits at a constant zero on healthy runs, so it carries the
+    # absolute MAD floor (see robust_z): a single benched client above
+    # an all-zero history is the system WORKING, not an anomaly — a
+    # multi-client jump still fires. tx_norm_max is scale-dependent
+    # (model/lr set its magnitude), so no fixed absolute floor fits;
+    # its healthy history has a nonzero median and the 2%-of-median
+    # relative floor does the quieting instead.
+    dict(name="update_norm_outlier", event="client_stats",
+         field="tx_norm_max", kind="z", direction="high",
+         severity="warn"),
+    dict(name="quarantine_growth", event="defense", field="quarantined",
+         kind="z", direction="high", severity="warn",
+         mad_floor_abs=0.5),
 )
 
 
@@ -108,22 +126,40 @@ def _extract(rule: Dict[str, Any], fields: Dict[str, Any]) -> Any:
         if isinstance(hi, (int, float)) and isinstance(lo, (int, float)):
             return float(hi) - float(lo)
         return None
+    if rule["event"] == "client_stats" and rule["field"] == "tx_norm_max":
+        # the update_norm_outlier feed: the round's largest per-client
+        # transmitted-update norm (the boosted-client signature)
+        q = (fields.get("quantiles") or {}).get("tx_norm") or {}
+        v = q.get("max")
+        return float(v) if isinstance(v, (int, float)) else None
     return fields.get(rule["field"])
 
 
 def robust_z(value: float, history: List[float],
-             mad_floor_frac: float = 0.02) -> Dict[str, float]:
+             mad_floor_frac: float = 0.02,
+             mad_floor_abs: float = 0.0) -> Dict[str, float]:
     """Median/MAD z-score of ``value`` against ``history`` (the standard
     0.6745 normal-consistency factor, so z compares to sigma units).
-    The MAD is floored at ``mad_floor_frac * |median|`` (and an absolute
-    epsilon) so a constant or quantized history cannot make every
-    deviation infinite."""
+
+    The MAD is floored at ``mad_floor_frac * |median|`` so a constant or
+    quantized history cannot make every deviation infinite — but that
+    relative floor is itself ZERO when the rolling median is zero (e.g.
+    staleness on a no-latency run, quarantine counts on a healthy
+    fleet), and the old 1e-12 backstop made the FIRST nonzero tick fire
+    with an astronomical z. ``mad_floor_abs`` is the fix: an absolute
+    epsilon floor, supplied per rule for metrics whose healthy state is
+    a constant zero in natural units of ~1 (a floor of 0.5 keeps a
+    single-unit tick below z = 1.35 while a jump of several units still
+    breaches the default threshold 6). It defaults to 0 so continuous
+    metrics with real scatter (loss, mfu) keep their full sensitivity.
+    Regression-tested on a constant-zero-then-tick history in
+    tests/test_health.py."""
     xs = sorted(history)
     n = len(xs)
     med = (xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2]))
     dev = sorted(abs(x - med) for x in xs)
     mad = (dev[n // 2] if n % 2 else 0.5 * (dev[n // 2 - 1] + dev[n // 2]))
-    mad = max(mad, mad_floor_frac * abs(med), 1e-12)
+    mad = max(mad, mad_floor_frac * abs(med), mad_floor_abs, 1e-12)
     return {"zscore": 0.6745 * (value - med) / mad, "median": med,
             "mad": mad}
 
@@ -214,7 +250,9 @@ class AnomalyMonitor:
                                      median=None, mad=None,
                                      window=len(hist), action=self.action)
             elif numeric and len(hist) >= self.min_points and quiet <= 0:
-                stats = robust_z(float(value), list(hist))
+                stats = robust_z(float(value), list(hist),
+                                 mad_floor_abs=rule.get("mad_floor_abs",
+                                                        0.0))
                 z = stats["zscore"]
                 breach = (z > self.z_thresh
                           if rule.get("direction") == "high"

@@ -343,6 +343,30 @@ class RunTelemetry:
                    velocity_norm=dev("velocity_norm"),
                    lr=float(lr))
 
+    def defense_event(self, *, rnd: int, defense: str, adversary: str,
+                      nonfinite_action: str,
+                      device: Optional[Dict[str, Any]] = None,
+                      quarantine: Optional[Dict[str, Any]] = None,
+                      injected: Optional[Dict[str, Any]] = None) -> None:
+        """Robustness status of one round (schema v5, core/runtime.py):
+        ``device`` is the round's defense scalar dict (already fetched;
+        NaN = not-applicable, serialized null), ``quarantine`` the
+        QuarantineLedger snapshot, ``injected`` the per-fate injected
+        slot counts when fault injection is on."""
+        device = device or {}
+        q = quarantine or {}
+        self.event("defense", round=int(rnd), defense=defense,
+                   adversary=adversary, nonfinite_action=nonfinite_action,
+                   clip_frac=device.get("clip_frac"),
+                   clip_thresh=device.get("clip_thresh"),
+                   clipped_mass=device.get("clipped_mass"),
+                   trim_frac=device.get("trim_frac"),
+                   nonfinite_clients=device.get("nonfinite_clients"),
+                   quarantined=int(q.get("quarantined", 0)),
+                   ejected=int(q.get("ejected", 0)),
+                   quarantine_ids_digest=q.get("quarantine_ids_digest"),
+                   injected=injected)
+
     def alert_event(self, *, rnd: int, rule: str, severity: str,
                     metric: str, value: Optional[float] = None,
                     zscore: Optional[float] = None,

@@ -20,14 +20,14 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterable, List, Tuple
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 # streams written by older code stay readable: v1 lacks the span /
 # utilization event types (added in v2), v2 lacks client_stats / alert
-# (added in v3), v3 lacks async_round (added in v4), but each is
-# otherwise a subset of its successor — so the validator accepts any
-# supported manifest version. A version it does not know is the error,
-# not a version merely older than current.
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, SCHEMA_VERSION)
+# (added in v3), v3 lacks async_round (added in v4), v4 lacks defense
+# (added in v5), but each is otherwise a subset of its successor — so
+# the validator accepts any supported manifest version. A version it
+# does not know is the error, not a version merely older than current.
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, SCHEMA_VERSION)
 TELEMETRY_BASENAME = "telemetry.jsonl"
 
 
@@ -267,6 +267,32 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "error_norm": _opt_num,
         "velocity_norm": _opt_num,
         "lr": _num,
+    },
+    # robustness status of one round (schema v5; core/runtime.py +
+    # core/quarantine.py): what the configured defense actually did —
+    # clip fraction/threshold/removed mass (normclip), trim fraction
+    # (trim), per-round nonfinite-client count and the quarantine
+    # ledger's bench/eject state — plus the injected adversary counts
+    # when fault injection is on. Emitted only when the robustness
+    # subsystem is active (defense, adversary or quarantine configured);
+    # numeric fields are null where not applicable to the configured
+    # defense/action — never silently zero
+    "defense": {
+        "round": _int,
+        "defense": _str,              # none | normclip | trim
+        "adversary": _str,            # none | labelflip | ... (config)
+        "nonfinite_action": _str,     # abort | quarantine
+        "clip_frac": _opt_num,        # clipped / participating clients
+        "clip_thresh": _opt_num,      # per-datum norm threshold applied
+        "clipped_mass": _opt_num,     # L2 of the mass the clip removed
+        "trim_frac": _opt_num,        # 2*floor(trim_frac*V)/V actually
+                                      # cut, V = live (data-carrying)
+                                      # clients, not the slot count W
+        "nonfinite_clients": _opt_num,  # zeroed out of THIS round
+        "quarantined": _int,          # currently benched (backoff running)
+        "ejected": _int,              # permanently ejected so far
+        "quarantine_ids_digest": _opt_str,  # "<n>:<sha1[:12]>" or null
+        "injected": _opt_dict,        # {kind: slots-this-round} when on
     },
     # online anomaly alert (telemetry/health.py): a monitor rule fired
     # against the rolling median/MAD history of a watched stream field.

@@ -78,6 +78,20 @@ _SAMPLE_OVERRIDES = {
     "update_norm": 0.25,
     "error_norm": 1.5,
     "velocity_norm": 0.75,
+    # defense: one schema-v5 robustness record (a normclip run absorbing
+    # a scale attack, one client benched)
+    "defense": "normclip",
+    "adversary": "scale",
+    "nonfinite_action": "quarantine",
+    "clip_frac": 0.25,
+    "clip_thresh": 42.0,
+    "clipped_mass": 1043.0,
+    "trim_frac": None,
+    "nonfinite_clients": 1.0,
+    "quarantined": 1,
+    "ejected": 0,
+    "quarantine_ids_digest": "1:c1dfd96eea8c",
+    "injected": {"scale": 1},
     # alert: a fired statistical rule
     "rule": "loss_spike",
     "severity": "warn",

@@ -23,6 +23,13 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_async_agg.py \
     tests/test_scenarios.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
+# adversary injection + robust aggregation + quarantine: a regression
+# here (broken HLO identity with defenses off, unsound clip/trim math,
+# quarantine semantics drift) fails in seconds, before the full suite
+env JAX_PLATFORMS=cpu python -m pytest tests/test_defense.py \
+    tests/test_quarantine.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -99,6 +99,13 @@ ASYNC_ROUND_KEYS = ("staleness_mean", "staleness_max", "discount_mean",
                     "discount_min", "error_norm", "loss", "n_cohorts",
                     "partial")
 
+# defense fields the analyzer reads (schema v5, core/runtime.py +
+# core/quarantine.py). Same jax-free literal pattern; tests/
+# test_defense.py pins these names against
+# telemetry/schema.EVENT_FIELDS["defense"].
+DEFENSE_KEYS = ("clip_frac", "clip_thresh", "clipped_mass", "trim_frac",
+                "nonfinite_clients", "quarantined", "ejected")
+
 
 def load_events(path: str) -> List[Dict[str, Any]]:
     if os.path.isdir(path):
@@ -248,6 +255,25 @@ def summarize(events: List[Dict[str, Any]], label: str = "") -> None:
             line += f"; error_norm first {errs[0]:.5g} last {errs[-1]:.5g}"
         print(line)
 
+    defs = by_kind(events, "defense")
+    if defs:
+        # robustness line: what the defense did over the run (schema v5)
+        last = defs[-1]
+        cf = [_fin(e.get("clip_frac")) for e in defs]
+        cf = [v for v in cf if v is not None]
+        nfc = sum(_fin(e.get("nonfinite_clients")) or 0.0 for e in defs)
+        line = (f"-- defense: {len(defs)} records, "
+                f"{last.get('defense', '?')}"
+                + (f" vs adversary={last.get('adversary')}"
+                   if last.get("adversary") not in (None, "none") else ""))
+        if cf:
+            line += (f", clip_frac mean {sum(cf) / len(cf):.3f} "
+                     f"max {max(cf):.3f}")
+        line += (f"; nonfinite clients {nfc:.0f} total, "
+                 f"quarantined {last.get('quarantined', 0)} "
+                 f"ejected {last.get('ejected', 0)}")
+        print(line)
+
     epochs = by_kind(events, "epoch")
     if epochs:
         print("-- epochs")
@@ -370,6 +396,51 @@ def clients(events: List[Dict[str, Any]]) -> int:
         print("-- clients most often owning the round's max loss: "
               + " ".join(f"#{c}x{n}" for c, n in top))
     return 0
+
+
+# ------------------------------------------------------------------- defense
+
+
+def defense(events: List[Dict[str, Any]]) -> int:
+    """Robustness report from the schema-v5 ``defense`` stream: the
+    configured attack/defense/action, clip and trim activity trends,
+    per-round nonfinite counts, the quarantine ledger trajectory and
+    injected-fate totals. Exits 1 when any client was permanently
+    EJECTED — a fleet losing clients for good is worth a red exit in a
+    health-gate pipeline even if the run itself finished."""
+    defs = by_kind(events, "defense")
+    if not defs:
+        print("no defense events (pre-v5 stream, or the robustness "
+              "subsystem — --adversary/--defense/--nonfinite_action "
+              "quarantine — was not configured)")
+        return 0
+    first, last = defs[0], defs[-1]
+    print(f"== defense: {len(defs)} records, defense="
+          f"{last.get('defense', '?')} adversary="
+          f"{last.get('adversary', '?')} nonfinite_action="
+          f"{last.get('nonfinite_action', '?')}")
+    for key in DEFENSE_KEYS:
+        vals = [_fin(e.get(key)) for e in defs]
+        vals = [v for v in vals if v is not None]
+        if not vals:
+            continue
+        print(f"   {key:18s} first {vals[0]:9.4g} last {vals[-1]:9.4g} "
+              f"min {min(vals):9.4g} max {max(vals):9.4g}")
+    inj: Dict[str, float] = {}
+    for e in defs:
+        for kind, n in (e.get("injected") or {}).items():
+            if isinstance(n, (int, float)):
+                inj[str(kind)] = inj.get(str(kind), 0.0) + float(n)
+    if inj:
+        print("-- injected slots (sum over records): "
+              + " ".join(f"{k}x{v:.0f}" for k, v in sorted(inj.items())))
+    digest = last.get("quarantine_ids_digest")
+    if digest:
+        print(f"-- quarantine ids digest (last): {digest}")
+    ejected = int(last.get("ejected") or 0)
+    print(f"-- {'EJECTIONS: ' + str(ejected) if ejected else 'no ejections'}"
+          f" (quarantined now: {last.get('quarantined', 0)})")
+    return 1 if ejected else 0
 
 
 # ------------------------------------------------------------------- timeline
@@ -520,6 +591,30 @@ def diff(a: List[Dict[str, Any]], b: List[Dict[str, Any]],
                 f"(> {args.signal_ratio:.2f}x — staleness-induced EF "
                 "divergence class)")
 
+    da, db = by_kind(a, "defense"), by_kind(b, "defense")
+    if da and db:
+        # schema-v5 robustness gates: a defended run whose clip fraction
+        # rises sharply is absorbing a new attack (or clipping honest
+        # clients); growth of the bench/eject counts is a fleet-health
+        # regression in its own right
+        fa = _fin(da[-1].get("clip_frac"))
+        fb = _fin(db[-1].get("clip_frac"))
+        if fa is not None and fb is not None \
+                and fb > fa + args.clip_frac_rise:
+            problems.append(
+                f"defense: final clip_frac {fa:.3f} -> {fb:.3f} "
+                f"(rise > {args.clip_frac_rise:.2f} — the norm clip is "
+                "binding on far more clients than the baseline)")
+        qa = (_fin(da[-1].get("quarantined")) or 0) + \
+            (_fin(da[-1].get("ejected")) or 0)
+        qb = (_fin(db[-1].get("quarantined")) or 0) + \
+            (_fin(db[-1].get("ejected")) or 0)
+        if qb > qa + args.quarantine_growth:
+            problems.append(
+                f"defense: quarantined+ejected {qa:.0f} -> {qb:.0f} "
+                f"(growth > {args.quarantine_growth} — more clients are "
+                "producing nonfinite uploads than the baseline)")
+
     def final_loss(events):
         eps = by_kind(events, "epoch")
         if eps:
@@ -602,6 +697,13 @@ def main(argv=None) -> int:
                    help="max ABSOLUTE rise of the final async_round "
                         "staleness_mean (async buffered-aggregation "
                         "runs; commits-stale units)")
+    d.add_argument("--clip_frac_rise", type=float, default=0.25,
+                   help="max ABSOLUTE rise of the final defense "
+                        "clip_frac (schema-v5 defense streams)")
+    d.add_argument("--quarantine_growth", type=int, default=0,
+                   help="quarantined+ejected client-count growth "
+                        "tolerated (default 0: any new benched/ejected "
+                        "client fails)")
     d.add_argument("--client_spread_ratio", type=float, default=2.0,
                    help="max growth factor of the final per-client loss "
                         "spread (p95-p5) — population divergence")
@@ -615,6 +717,10 @@ def main(argv=None) -> int:
                         help="per-client population trends from the "
                              "client_stats stream")
     cl.add_argument("path")
+    de = sub.add_parser("defense",
+                        help="robustness report from the schema-v5 "
+                             "defense stream (exit 1 on ejections)")
+    de.add_argument("path")
     t = sub.add_parser("timeline",
                        help="render the span stream into a perfetto/"
                             "chrome-tracing trace.json")
@@ -629,6 +735,8 @@ def main(argv=None) -> int:
         return alerts(load_events(args.path))
     if args.cmd == "clients":
         return clients(load_events(args.path))
+    if args.cmd == "defense":
+        return defense(load_events(args.path))
     if args.cmd == "timeline":
         return timeline(load_events(args.path), args.out)
     if args.cmd == "diff":

@@ -57,7 +57,7 @@ class FixedScenario:
         self.dropped = set(dropped)
         self.masks = masks or {}
 
-    def fate(self, cohort_idx, mask):
+    def fate(self, cohort_idx, mask, client_ids=None):
         return CohortFate(float(self.latencies.get(cohort_idx, 0.0)),
                           cohort_idx in self.dropped,
                           self.masks.get(cohort_idx, mask))

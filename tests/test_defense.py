@@ -1,0 +1,555 @@
+"""Adversarial client injection + robust aggregation (PR 7):
+numpy-reference norm-clip and trimmed-mean in dense AND table space,
+clip-then-sketch == sketch-then-clip for the linear case, the rolling-
+median threshold semantics, deterministic adversary fates, per-kind
+injection effects, HLO byte-identity with the robustness flags off,
+async-path parity, the schema-v5 defense event round-trip, and the
+teleview DEFENSE_KEYS jax-free literal pin."""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.core import FedRuntime
+from commefficient_tpu.core.client import (flip_labels, inject_adversary,
+                                           quarantine_zero)
+from commefficient_tpu.core.server import robust_aggregate
+from commefficient_tpu.data.scenarios import (AdversaryPlan, CohortFate,
+                                              StragglerScenario,
+                                              make_adversary)
+from commefficient_tpu.ops.sketch import make_sketch_impl
+from commefficient_tpu.telemetry import RunTelemetry, validate_file
+from commefficient_tpu.telemetry.schema import EVENT_FIELDS
+from tests.test_telemetry import make_batch, make_runtime
+
+W = 4
+
+
+def _teleview():
+    spec = importlib.util.spec_from_file_location(
+        "teleview", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "teleview.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------- numpy references
+
+
+def _np_normclip(tx, n_valid, mult, ref=np.nan):
+    """Reference norm-clip: per-datum norms, median threshold, rescale."""
+    tx = np.asarray(tx, np.float64)
+    n = np.asarray(n_valid, np.float64)
+    denom = np.maximum(n, 1.0)
+    flat = tx.reshape(tx.shape[0], -1)
+    norms = np.sqrt((flat * flat).sum(axis=1)) / denom
+    usable = (n > 0) & np.isfinite(norms)
+    med = np.nanmedian(np.where(usable, norms, np.nan))
+    thresh = mult * (med if np.isnan(ref) else ref)
+    factors = np.minimum(1.0, thresh / np.maximum(norms, 1e-12))
+    factors = np.where(usable, factors, 1.0)
+    agg = (tx * factors.reshape((-1,) + (1,) * (tx.ndim - 1))).sum(axis=0)
+    return agg, med, thresh, factors
+
+
+def _np_trim(tx, n_valid, trim_frac):
+    tx = np.asarray(tx, np.float64)
+    n = np.asarray(n_valid, np.float64)
+    denom = np.maximum(n, 1.0)
+    u = tx / denom.reshape((-1,) + (1,) * (tx.ndim - 1))
+    t = int(trim_frac * tx.shape[0])
+    s = np.sort(u, axis=0)
+    core = s[t: tx.shape[0] - t] if t else s
+    return core.mean(axis=0) * n.sum()
+
+
+def test_normclip_matches_numpy_reference_dense():
+    rng = np.random.RandomState(0)
+    tx = rng.randn(6, 40).astype(np.float32) * rng.uniform(1, 5, (6, 1))
+    n = np.asarray([8, 8, 4, 8, 8, 8], np.float32)
+    cfg = FedConfig(defense="normclip", defense_clip_mult=2.0)
+    agg, med, stats = robust_aggregate(cfg, jnp.asarray(tx),
+                                       jnp.asarray(n),
+                                       ref_thresh=jnp.float32(np.nan))
+    ref_agg, ref_med, ref_thresh, factors = _np_normclip(tx, n, 2.0)
+    np.testing.assert_allclose(np.asarray(agg), ref_agg, rtol=1e-5)
+    assert float(med) == pytest.approx(ref_med, rel=1e-6)
+    assert float(stats["clip_thresh"]) == pytest.approx(ref_thresh,
+                                                        rel=1e-6)
+    assert float(stats["clip_frac"]) == pytest.approx(
+        ((factors < 1.0).sum()) / 6)
+    # removed mass: l2 over the clipped clients' removed norms
+    denom = np.maximum(n, 1.0)
+    norms = np.sqrt((tx.reshape(6, -1) ** 2).sum(1)) / denom
+    removed = np.sqrt((((1 - factors) * norms * denom) ** 2).sum())
+    assert float(stats["clipped_mass"]) == pytest.approx(removed, rel=1e-4)
+
+
+def test_normclip_table_space_matches_numpy_reference():
+    """Norm-clip on per-client (r, c) sketch tables: Frobenius norms."""
+    rng = np.random.RandomState(1)
+    tx = rng.randn(5, 3, 16).astype(np.float32)
+    n = np.full(5, 4.0, np.float32)
+    cfg = FedConfig(defense="normclip", defense_clip_mult=1.5)
+    agg, med, stats = robust_aggregate(cfg, jnp.asarray(tx),
+                                       jnp.asarray(n),
+                                       ref_thresh=jnp.float32(np.nan))
+    ref_agg, _, _, _ = _np_normclip(tx, n, 1.5)
+    assert agg.shape == (3, 16)
+    np.testing.assert_allclose(np.asarray(agg), ref_agg, rtol=1e-5)
+
+
+def test_normclip_uses_rolling_reference_not_current_round():
+    """With a warm ref_thresh the boosted round's own (contaminated)
+    median must NOT set the threshold — that is the whole point of the
+    rolling reference."""
+    rng = np.random.RandomState(2)
+    tx = rng.randn(4, 30).astype(np.float32)
+    tx[1] *= 1000.0                       # boosted client
+    n = np.full(4, 8.0, np.float32)
+    cfg = FedConfig(defense="normclip", defense_clip_mult=3.0)
+    ref = jnp.float32(1.0)                # healthy historical median
+    agg, med, stats = robust_aggregate(cfg, jnp.asarray(tx),
+                                       jnp.asarray(n), ref_thresh=ref)
+    assert float(stats["clip_thresh"]) == pytest.approx(3.0)
+    ref_agg, _, _, _ = _np_normclip(tx, n, 3.0, ref=1.0)
+    np.testing.assert_allclose(np.asarray(agg), ref_agg, rtol=1e-5)
+    # the boosted client was crushed back to the threshold
+    assert float(stats["clip_frac"]) >= 0.25
+
+
+def test_trim_matches_numpy_reference_dense_and_table():
+    rng = np.random.RandomState(3)
+    for shape in ((8, 50), (8, 2, 12)):
+        tx = rng.randn(*shape).astype(np.float32)
+        n = rng.randint(1, 9, 8).astype(np.float32)
+        cfg = FedConfig(defense="trim", defense_trim_frac=0.25)
+        agg, med, stats = robust_aggregate(cfg, jnp.asarray(tx),
+                                           jnp.asarray(n))
+        assert med is None
+        np.testing.assert_allclose(np.asarray(agg), _np_trim(tx, n, 0.25),
+                                   rtol=1e-5)
+        assert float(stats["trim_frac"]) == pytest.approx(2 * 2 / 8)
+
+
+def test_trim_drops_coordinate_outliers():
+    """Concentrated honest updates + sign-flipped minority: the trimmed
+    mean recovers the honest mean while the plain mean is dragged."""
+    rng = np.random.RandomState(4)
+    honest = np.ones((6, 20), np.float32) + 0.01 * rng.randn(6, 20)
+    flipped = -np.ones((2, 20), np.float32)
+    tx = np.concatenate([honest, flipped]).astype(np.float32)
+    n = np.ones(8, np.float32)
+    cfg = FedConfig(defense="trim", defense_trim_frac=0.25)
+    agg, _, _ = robust_aggregate(cfg, jnp.asarray(tx), jnp.asarray(n))
+    trimmed_mean = np.asarray(agg) / 8.0
+    assert np.all(np.abs(trimmed_mean - 1.0) < 0.05)
+    plain_mean = tx.sum(0) / 8.0
+    assert np.all(plain_mean < 0.6)       # the mean was dragged
+
+
+def test_trim_excludes_zero_datum_slots():
+    """A quarantine-benched / participation-masked slot carries NO vote:
+    its 0/1 = 0 placeholder update must not dilute the trimmed mean
+    (with 2 live clients in an 8-slot round the defended update would
+    otherwise shrink 4x toward zero)."""
+    tx = np.zeros((8, 10), np.float32)
+    tx[0] = 1.0
+    tx[1] = 1.0
+    n = np.zeros(8, np.float32)
+    n[:2] = 1.0                           # only two slots participated
+    cfg = FedConfig(defense="trim", defense_trim_frac=0.25)
+    agg, _, stats = robust_aggregate(cfg, jnp.asarray(tx), jnp.asarray(n))
+    # agg / n_total must equal the live clients' trimmed mean, 1.0
+    np.testing.assert_allclose(np.asarray(agg) / n.sum(),
+                               np.ones(10), rtol=1e-6)
+    # trim count follows the LIVE cohort: floor(0.25 * 2) = 0
+    assert float(stats["trim_frac"]) == 0.0
+    # and with enough live clients the trim still drops live extremes
+    n2 = np.ones(8, np.float32)
+    n2[6:] = 0.0                          # 6 live, 2 benched
+    tx2 = np.ones((8, 4), np.float32)
+    tx2[0] = 100.0                        # a live outlier
+    tx2[6:] = 0.0
+    agg2, _, stats2 = robust_aggregate(cfg, jnp.asarray(tx2),
+                                       jnp.asarray(n2))
+    np.testing.assert_allclose(np.asarray(agg2) / n2.sum(),
+                               np.ones(4), rtol=1e-6)
+    assert float(stats2["trim_frac"]) == pytest.approx(2 / 6)
+
+
+def test_clip_commutes_with_linear_sketch():
+    """An l2 clip is a rescaling, and the sketch is linear:
+    encode(f * g) == f * encode(g) — clipping before the encode equals
+    clipping the table by the same factor (the transmitted-space
+    soundness claim of --defense normclip for sketch mode)."""
+    d, c, r = 256, 64, 3
+    cs = make_sketch_impl("circ", d, c, r, 2, seed=7)
+    g = jnp.asarray(np.random.RandomState(5).randn(d), jnp.float32)
+    norm = float(jnp.linalg.norm(g))
+    f = min(1.0, 0.3 * norm / norm)       # a real clip factor < 1
+    f = 0.37
+    enc_clip = cs.encode(f * g)
+    clip_enc = f * cs.encode(g)
+    np.testing.assert_allclose(np.asarray(enc_clip), np.asarray(clip_enc),
+                               rtol=1e-5, atol=1e-6)
+    # and the table Frobenius norm scales by exactly the same factor,
+    # so a threshold computed in table space clips the same clients
+    assert float(jnp.linalg.norm(enc_clip)) == pytest.approx(
+        f * float(jnp.linalg.norm(cs.encode(g))), rel=1e-5)
+
+
+# ------------------------------------------------- adversary fates
+
+
+def test_adversary_plan_deterministic_and_frac_bounded():
+    a = AdversaryPlan("signflip", 0.25, seed=3)
+    b = AdversaryPlan("signflip", 0.25, seed=3)
+    u1, u2 = a.universe_mask(64), b.universe_mask(64)
+    np.testing.assert_array_equal(u1, u2)
+    # independent of universe size / query order (keyed per client)
+    np.testing.assert_array_equal(u1[:16], a.universe_mask(16))
+    np.testing.assert_array_equal(a.slot_mask([5, 3, 5]),
+                                  u1[[5, 3, 5]])
+    assert 0 < u1.mean() < 0.6            # roughly frac, never all/none
+    assert AdversaryPlan("signflip", 0.25, seed=4).universe_mask(
+        64).tolist() != u1.tolist()
+    assert not AdversaryPlan("none", 0.5).universe_mask(8).any()
+
+
+def test_adversary_plan_validation():
+    with pytest.raises(ValueError, match="unknown adversary kind"):
+        AdversaryPlan("backdoor", 0.1)
+    with pytest.raises(ValueError, match="frac"):
+        AdversaryPlan("scale", 1.5)
+    with pytest.raises(ValueError, match="scale"):
+        AdversaryPlan("scale", 0.5, scale=0.0)
+
+
+def test_cohort_fate_carries_adversary_assignment():
+    plan = AdversaryPlan("nan", 0.5, seed=9)
+    sc = StragglerScenario("none", seed=9, dropout=0.0, adversary=plan)
+    mask = np.ones((4, 2), bool)
+    ids = np.asarray([1, 2, 3, 4])
+    fate = sc.fate(0, mask, client_ids=ids)
+    np.testing.assert_array_equal(fate.adversary, plan.slot_mask(ids))
+    # without ids (or without a plan) the field stays None
+    assert sc.fate(0, mask).adversary is None
+    assert StragglerScenario("none", seed=9, dropout=0.1).fate(
+        0, mask, client_ids=ids).adversary is None
+    assert CohortFate(0.0, False, mask).adversary is None
+
+
+def test_async_aggregator_rejects_mismatched_adversary_plans():
+    """The scenario's CohortFate.adversary annotation and the runtime's
+    baked universe mask must describe the SAME assignment — a seed
+    mismatch fails fast instead of silently diverging."""
+    from commefficient_tpu.core.async_agg import AsyncAggregator
+
+    kw = dict(mode="uncompressed", error_type="none",
+              adversary="signflip", adversary_frac=0.5,
+              fused_clients=False, async_agg=True)
+    rt = make_runtime(**kw)
+    bad = StragglerScenario(
+        "none", seed=rt.cfg.seed, dropout=0.1,
+        adversary=AdversaryPlan("signflip", 0.5, seed=rt.cfg.seed + 1))
+    with pytest.raises(ValueError, match="disagrees"):
+        AsyncAggregator(rt, scenario=bad)
+    good = StragglerScenario(
+        "none", seed=rt.cfg.seed, dropout=0.1,
+        adversary=make_adversary(rt.cfg))
+    AsyncAggregator(rt, scenario=good)    # matching plans accepted
+
+
+def test_make_adversary_from_config():
+    assert make_adversary(FedConfig()) is None
+    plan = make_adversary(FedConfig(adversary="scale", adversary_frac=0.3,
+                                    adversary_scale=7.0, seed=11))
+    assert plan.kind == "scale" and plan.scale == 7.0 and plan.seed == 11
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="injects nothing"):
+        FedConfig(adversary="scale")
+    with pytest.raises(ValueError, match="adversary_frac"):
+        FedConfig(adversary_frac=0.5)
+    with pytest.raises(ValueError, match="adversary_frac"):
+        FedConfig(adversary="scale", adversary_frac=-0.1)
+    with pytest.raises(ValueError, match="adversary_scale"):
+        FedConfig(adversary="scale", adversary_frac=0.5,
+                  adversary_scale=-1.0)
+    with pytest.raises(ValueError, match="defense_trim_frac"):
+        FedConfig(defense="trim", defense_trim_frac=0.5)
+    with pytest.raises(ValueError, match="defense_clip_mult"):
+        FedConfig(defense="normclip", defense_clip_mult=0.0)
+    with pytest.raises(ValueError, match="quarantine_backoff"):
+        FedConfig(nonfinite_action="quarantine", quarantine_backoff=0)
+    with pytest.raises(ValueError, match="quarantine_strikes"):
+        FedConfig(nonfinite_action="quarantine", quarantine_strikes=0)
+
+
+# ------------------------------------------------- injection helpers
+
+
+def test_inject_adversary_kinds_numpy_reference():
+    rng = np.random.RandomState(6)
+    tx = jnp.asarray(rng.randn(4, 10), jnp.float32)
+    adv = jnp.asarray([False, True, False, True])
+    rngs = jax.random.split(jax.random.PRNGKey(0), 4)
+    n = jnp.full((4,), 2.0)
+
+    sf = inject_adversary(FedConfig(adversary="signflip",
+                                    adversary_frac=0.5), tx, adv, rngs, n)
+    np.testing.assert_array_equal(np.asarray(sf[0]), np.asarray(tx[0]))
+    np.testing.assert_array_equal(np.asarray(sf[1]), -np.asarray(tx[1]))
+
+    sc = inject_adversary(FedConfig(adversary="scale", adversary_frac=0.5,
+                                    adversary_scale=5.0), tx, adv, rngs, n)
+    np.testing.assert_allclose(np.asarray(sc[3]), 5.0 * np.asarray(tx[3]),
+                               rtol=1e-6)
+
+    nz = inject_adversary(FedConfig(adversary="noise", adversary_frac=0.5,
+                                    adversary_scale=2.0), tx, adv, rngs, n)
+    np.testing.assert_array_equal(np.asarray(nz[0]), np.asarray(tx[0]))
+    assert not np.allclose(np.asarray(nz[1]), np.asarray(tx[1]))
+    # deterministic: same keys -> same noise
+    nz2 = inject_adversary(FedConfig(adversary="noise",
+                                     adversary_frac=0.5,
+                                     adversary_scale=2.0), tx, adv, rngs, n)
+    np.testing.assert_array_equal(np.asarray(nz), np.asarray(nz2))
+
+    na = inject_adversary(FedConfig(adversary="nan", adversary_frac=0.5),
+                          tx, adv, rngs, n)
+    assert np.isnan(np.asarray(na[1])).all()
+    assert np.isfinite(np.asarray(na[2])).all()
+
+
+def test_inject_skips_non_participating_slots():
+    """A masked-out slot (zero valid datums) uploads nothing — injecting
+    into its zero placeholder would fabricate quarantine strikes for a
+    client that never participated."""
+    tx = jnp.zeros((3, 8))
+    adv = jnp.asarray([True, True, False])
+    rngs = jax.random.split(jax.random.PRNGKey(1), 3)
+    n = jnp.asarray([4.0, 0.0, 4.0])      # slot 1 is benched/masked
+    out = inject_adversary(FedConfig(adversary="nan", adversary_frac=0.5),
+                           tx, adv, rngs, n)
+    assert np.isnan(np.asarray(out[0])).all()
+    assert np.isfinite(np.asarray(out[1])).all()
+
+
+def test_flip_labels():
+    batch = {"x": jnp.zeros((3, 4, 2)),
+             "target": jnp.asarray([[0, 1, 9, 4]] * 3)}
+    adv = jnp.asarray([False, True, False])
+    out = flip_labels(batch, adv, 10)
+    np.testing.assert_array_equal(np.asarray(out["target"][0]),
+                                  [0, 1, 9, 4])
+    np.testing.assert_array_equal(np.asarray(out["target"][1]),
+                                  [9, 8, 0, 5])
+    with pytest.raises(ValueError, match="target"):
+        flip_labels({"x": jnp.zeros((3, 4))}, adv, 10)
+
+
+def test_quarantine_zero_semantics():
+    tx = jnp.asarray([[1.0, 2.0], [np.nan, 1.0], [3.0, 4.0]])
+    n = jnp.asarray([2.0, 2.0, 2.0])
+    res = (jnp.asarray([0.5, 0.6, np.nan]),)
+    tx2, n2, res2, fin = quarantine_zero(tx, n, res)
+    np.testing.assert_array_equal(np.asarray(fin), [True, False, False])
+    np.testing.assert_array_equal(np.asarray(n2), [2.0, 0.0, 0.0])
+    assert np.isfinite(np.asarray(tx2)).all()
+    assert np.isfinite(np.asarray(res2[0])).all()
+
+
+# ------------------------------------------------- runtime integration
+
+
+def test_round_defense_ring_rolls_and_protects():
+    """The normclip threshold comes from PAST medians: a boosted round
+    cannot raise its own threshold; the ring fills one slot per round."""
+    rt = make_runtime(mode="uncompressed", error_type="none",
+                      defense="normclip", defense_window=4)
+    batch, mask, ids = make_batch()
+    state = rt.init_state()
+    assert np.isnan(np.asarray(state.defense_ref)).all()
+    for i in range(3):
+        state, m = rt.round(state, ids, batch, mask, 0.05)
+    ring = np.asarray(state.defense_ref)
+    assert np.isfinite(ring[:3]).all() and np.isnan(ring[3])
+    assert float(m["defense"]["clip_frac"]) == 0.0   # clean cohort
+
+
+def test_round_signflip_changes_weights_labelflip_needs_target():
+    rt_clean = make_runtime(mode="uncompressed", error_type="none")
+    rt_adv = make_runtime(mode="uncompressed", error_type="none",
+                          adversary="signflip", adversary_frac=0.99,
+                          fused_clients=False)
+    batch, mask, ids = make_batch()
+    s1, _ = rt_clean.round(rt_clean.init_state(), ids, batch, mask, 0.05)
+    s2, _ = rt_adv.round(rt_adv.init_state(), ids, batch, mask, 0.05)
+    assert not np.allclose(np.asarray(s1.ps_weights),
+                           np.asarray(s2.ps_weights))
+    # labelflip on a batch without integer labels fails with the
+    # explanation at trace time, not with a shape error
+    rt_lf = make_runtime(mode="uncompressed", error_type="none",
+                         adversary="labelflip", adversary_frac=0.99)
+    with pytest.raises(ValueError, match="labelflip"):
+        rt_lf.round(rt_lf.init_state(), ids, batch, mask, 0.05)
+
+
+def test_defense_flags_off_hlo_byte_identity():
+    """The robustness flags at their off-values must leave the round's
+    HLO byte-identical to a config that never names them — the
+    signals/client_stats discipline applied to the defense subsystem."""
+    rt_base = make_runtime(mode="uncompressed", error_type="none")
+    rt_expl = make_runtime(mode="uncompressed", error_type="none",
+                           adversary="none", adversary_frac=0.0,
+                           defense="none", nonfinite_action="abort",
+                           quarantine_backoff=16, quarantine_strikes=5)
+    batch, mask, ids = make_batch()
+    args = (rt_base.init_state(), ids, batch, mask,
+            jnp.asarray(0.05, jnp.float32), None)
+    assert (rt_base._round.lower(*args).as_text()
+            == rt_expl._round.lower(*args).as_text())
+    # sanity: turning a defense ON does change the lowering
+    rt_on = make_runtime(mode="uncompressed", error_type="none",
+                         defense="normclip")
+    assert (rt_on._round.lower(rt_on.init_state(), ids, batch, mask,
+                               jnp.asarray(0.05, jnp.float32),
+                               None).as_text()
+            != rt_base._round.lower(*args).as_text())
+
+
+def test_defense_stats_gated_on_telemetry():
+    rt = make_runtime(mode="uncompressed", error_type="none",
+                      defense="normclip", telemetry=False)
+    batch, mask, ids = make_batch()
+    _, m = rt.round(rt.init_state(), ids, batch, mask, 0.05)
+    assert m["defense"] is None           # observability off...
+    rt2 = make_runtime(mode="uncompressed", error_type="none",
+                       defense="normclip")
+    _, m2 = rt2.round(rt2.init_state(), ids, batch, mask, 0.05)
+    assert m2["defense"] is not None      # ...but the clip still ran
+    # clean cohort: threshold finite either way (the ring advanced)
+    assert float(m2["defense"]["clip_thresh"]) > 0
+
+
+def test_async_cohort_injection_bit_identical_to_sync():
+    """K=1/M=1 async with an update-space adversary must stay
+    bit-identical to the sync round with the same flags — injection
+    happens at cohort compute, which both paths share."""
+    from commefficient_tpu.core.async_agg import AsyncAggregator
+    from commefficient_tpu.data.fed_sampler import Round
+
+    kw = dict(mode="uncompressed", error_type="none",
+              adversary="signflip", adversary_frac=0.6,
+              nonfinite_action="quarantine", fused_clients=False)
+    rt_sync = make_runtime(**kw)
+    rt_async = make_runtime(async_agg=True, max_inflight=1, buffer_goal=1,
+                            **kw)
+    batch, mask, ids = make_batch()
+    s_state = rt_sync.init_state()
+    a_state = rt_async.init_state()
+    agg = AsyncAggregator(rt_async)
+    rnd = Round(np.asarray(ids), np.zeros((W, 4), np.int64),
+                np.ones((W, 4), bool))
+    for g in range(1, 4):
+        s_state, sm = rt_sync.round(s_state, ids, batch, mask, 0.05)
+        a_state, am, cms = agg.step(a_state, rnd, g, batch, 0.05)
+        np.testing.assert_array_equal(np.asarray(sm["results"][0]),
+                                      np.asarray(am["results"][0]))
+        np.testing.assert_array_equal(np.asarray(sm["client_finite"]),
+                                      np.asarray(am["client_finite"]))
+    np.testing.assert_array_equal(np.asarray(s_state.ps_weights),
+                                  np.asarray(a_state.ps_weights))
+
+
+# ------------------------------------------------- telemetry surface
+
+
+def test_defense_event_schema_roundtrip(tmp_path):
+    tel = RunTelemetry(str(tmp_path), "test", cfg=None)
+    tel.defense_event(rnd=3, defense="normclip", adversary="scale",
+                      nonfinite_action="quarantine",
+                      device={"clip_frac": 0.25, "clip_thresh": 4.2,
+                              "clipped_mass": 10.0,
+                              "trim_frac": float("nan"),
+                              "nonfinite_clients": 0.0},
+                      quarantine={"quarantined": 1, "ejected": 0,
+                                  "quarantine_ids_digest": "1:abc"},
+                      injected={"scale": 2})
+    tel.write_summary(aborted=False, n_rounds=1)
+    tel.close()
+    assert validate_file(tel.path) == []
+    ev = [json.loads(l) for l in open(tel.path)
+          if '"defense"' in l][0]
+    assert ev["clip_frac"] == 0.25 and ev["trim_frac"] is None
+    assert ev["quarantined"] == 1 and ev["injected"] == {"scale": 2}
+
+
+def test_teleview_defense_keys_literal_matches_schema():
+    """The jax-free DEFENSE_KEYS fallback in scripts/teleview.py must
+    track the canonical schema (same pin as ASYNC_ROUND_KEYS)."""
+    tv = _teleview()
+    spec = set(EVENT_FIELDS["defense"])
+    for key in tv.DEFENSE_KEYS:
+        assert key in spec, key
+
+
+def test_teleview_defense_subcommand(tmp_path, capsys):
+    tel = RunTelemetry(str(tmp_path), "test", cfg=None)
+    for i in range(3):
+        tel.defense_event(rnd=i, defense="trim", adversary="labelflip",
+                          nonfinite_action="abort",
+                          device={"trim_frac": 0.25},
+                          injected={"labelflip": 2})
+    tel.write_summary(aborted=False, n_rounds=3)
+    tel.close()
+    tv = _teleview()
+    rc = tv.main(["defense", tel.path])
+    out = capsys.readouterr().out
+    assert rc == 0                        # no ejections
+    assert "trim_frac" in out and "labelflipx6" in out
+    # an ejection turns the exit red
+    tel2 = RunTelemetry(str(tmp_path / "b"), "test", cfg=None)
+    tel2.defense_event(rnd=1, defense="none", adversary="nan",
+                       nonfinite_action="quarantine",
+                       quarantine={"quarantined": 0, "ejected": 2,
+                                   "quarantine_ids_digest": "2:dead"})
+    tel2.write_summary(aborted=False, n_rounds=1)
+    tel2.close()
+    assert tv.main(["defense", tel2.path]) == 1
+    # summarize grows a defense line
+    tv.main(["summarize", tel.path])
+    assert "-- defense:" in capsys.readouterr().out
+
+
+def test_teleview_diff_defense_gates(tmp_path):
+    tv = _teleview()
+
+    def stream(path, clip_frac, quarantined, ejected):
+        tel = RunTelemetry(str(path), "test", cfg=None)
+        tel.defense_event(rnd=1, defense="normclip", adversary="none",
+                          nonfinite_action="quarantine",
+                          device={"clip_frac": clip_frac},
+                          quarantine={"quarantined": quarantined,
+                                      "ejected": ejected,
+                                      "quarantine_ids_digest": None})
+        tel.write_summary(aborted=False, n_rounds=1)
+        tel.close()
+        return tel.path
+
+    a = stream(tmp_path / "a", 0.1, 0, 0)
+    b = stream(tmp_path / "b", 0.6, 2, 1)
+    assert tv.main(["diff", a, b]) == 1       # both gates breach
+    assert tv.main(["diff", a, a]) == 0
+    assert tv.main(["diff", a, b, "--clip_frac_rise", "0.9",
+                    "--quarantine_growth", "5"]) == 0

@@ -45,6 +45,106 @@ def test_robust_z_math():
     assert robust_z(2.0, [1.0] * 20)["zscore"] > 6
 
 
+def test_robust_z_constant_zero_history_mad_floor():
+    """The satellite regression: a metric whose rolling median is ZERO
+    (staleness on a no-latency run, quarantine counts on a healthy
+    fleet) has a zero relative MAD floor, and without an absolute
+    epsilon the FIRST nonzero tick fired with an astronomical z. With
+    the per-rule ``mad_floor_abs`` a single-unit tick stays far below
+    the default threshold 6 while a multi-unit jump still breaches."""
+    zeros = [0.0] * 20
+    # the old behavior (no absolute floor): any tick is "infinitely"
+    # surprising — this is the bug, kept visible as the default so
+    # continuous metrics keep full sensitivity
+    assert robust_z(1.0, zeros)["zscore"] > 1e6
+    # the fix, applied by the monitor for count-like rules
+    tick = robust_z(1.0, zeros, mad_floor_abs=0.5)
+    assert abs(tick["zscore"]) < 2.0
+    assert tick["mad"] == 0.5
+    jump = robust_z(10.0, zeros, mad_floor_abs=0.5)
+    assert jump["zscore"] > 6
+    # the absolute floor composes with (never weakens) the relative one
+    assert robust_z(2.0, [1.0] * 20,
+                    mad_floor_abs=1e-9)["zscore"] > 6
+
+
+def test_staleness_spike_quiet_on_first_tick_after_zero_history():
+    """Monitor-level regression for the same satellite: a no-latency
+    async run keeps staleness_max at 0; the first cohort that lands one
+    commit late must NOT fire staleness_spike (it used to)."""
+    mon = AnomalyMonitor(None, window=16, min_points=8)
+    fired = []
+    for i in range(1, 21):
+        fired += mon.observe("async_round",
+                             {"round": i, "staleness_max": 0.0,
+                              "staleness_mean": 0.0, "error_norm": 1.0,
+                              "loss": 2.0})
+    fired += mon.observe("async_round",
+                         {"round": 21, "staleness_max": 1.0,
+                          "staleness_mean": 0.2, "error_norm": 1.0,
+                          "loss": 2.0})
+    assert fired == [], fired
+    # a genuine staleness blowout still fires
+    fired = mon.observe("async_round",
+                        {"round": 22, "staleness_max": 25.0,
+                         "staleness_mean": 9.0, "error_norm": 1.0,
+                         "loss": 2.0})
+    assert [f["rule"] for f in fired] == ["staleness_spike"]
+
+
+def test_update_norm_outlier_rule():
+    """PR-7 rule: the round's max per-client transmitted-update norm
+    leaving the population envelope (the boosted-client signature)."""
+    mon = AnomalyMonitor(None, window=16, min_points=8)
+    rng = np.random.RandomState(3)
+    fired = []
+    for i in range(1, 21):
+        q = {"tx_norm": {"max": 5.0 + 0.1 * rng.randn()},
+             "loss": {"p5": 1.0, "p95": 1.2}}
+        fired += mon.observe("client_stats", {"round": i, "quantiles": q})
+    assert fired == []
+    fired = mon.observe("client_stats", {
+        "round": 21, "quantiles": {"tx_norm": {"max": 500.0},
+                                   "loss": {"p5": 1.0, "p95": 1.2}}})
+    assert [f["rule"] for f in fired] == ["update_norm_outlier"]
+    assert fired[0]["metric"] == "client_stats.tx_norm_max"
+    assert fired[0]["severity"] == "warn"
+
+
+def test_quarantine_growth_rule_single_bench_quiet_jump_fires():
+    """One benched client above an all-zero history is the system
+    WORKING (absolute MAD floor keeps it quiet); a multi-client jump is
+    the broken-fleet signature and fires."""
+    mon = AnomalyMonitor(None, window=16, min_points=8)
+    fired = []
+    for i in range(1, 21):
+        fired += mon.observe("defense", {"round": i, "quarantined": 0})
+    fired += mon.observe("defense", {"round": 21, "quarantined": 1})
+    assert fired == [], fired             # a single bench: quiet
+    fired = mon.observe("defense", {"round": 22, "quarantined": 8})
+    assert [f["rule"] for f in fired] == ["quarantine_growth"]
+
+
+def test_new_rules_healthy_stream_false_positive_gate():
+    """200 rounds of realistic healthy defense/client_stats streams must
+    fire NEITHER new rule (mirrors the main healthy-stream gate)."""
+    mon = AnomalyMonitor(None, window=32, min_points=8)
+    rng = np.random.RandomState(11)
+    for i in range(1, 201):
+        fired = mon.observe("client_stats", {
+            "round": i, "quantiles": {
+                "tx_norm": {"max": 4.0 + 0.5 * abs(rng.randn())},
+                "loss": {"p5": 1.5 + 0.05 * rng.randn(),
+                         "p95": 2.5 + 0.05 * rng.randn()}}})
+        # a healthy quarantine stream: count sits at 0 with the odd
+        # transient bench that recovers
+        q = 1 if i % 97 == 0 else 0
+        fired += mon.observe("defense", {"round": i, "quarantined": q,
+                                         "clip_frac": 0.0})
+        assert fired == [], (i, fired)
+    assert mon.n_observed == 400
+
+
 # ------------------------------------------------------------ the rules
 
 

@@ -1,0 +1,258 @@
+"""Nonfinite recovery (PR 7, --nonfinite_action quarantine): ledger
+strike/backoff/re-admission/ejection semantics, the sampler-side
+blocked-slot masking, local-state row protection, and the end-to-end
+driver contract — a NaN-injecting client under quarantine COMPLETES the
+run (defense events in the stream, finite final loss) where the same
+run under the default abort stops."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu import cv_train
+from commefficient_tpu.core.quarantine import QuarantineLedger
+from commefficient_tpu.data.fed_sampler import Round, mask_blocked
+from commefficient_tpu.telemetry import RunTelemetry, validate_file
+from commefficient_tpu.utils import TableLogger
+from tests.test_telemetry import StubDS, make_batch, make_runtime, \
+    read_events
+
+W = 4
+
+
+# --------------------------------------------------------------- ledger
+
+
+def test_ledger_strike_backoff_readmission_ejection():
+    led = QuarantineLedger(backoff=3, strikes=2)
+    # round 1: client 5 nonfinite -> strike 1, benched rounds 2..4
+    struck = led.observe(1, [4, 5, 6, 7], [True, False, True, True])
+    assert struck == [5]
+    assert led.blocked(2) == {5} and led.blocked(4) == {5}
+    assert led.blocked(5) == set()        # re-admitted after the backoff
+    assert led.quarantined(2) == 1 and led.quarantined(5) == 0
+    # round 5 retry fails -> strike 2 -> permanent ejection
+    struck = led.observe(5, [5, 6], [False, True])
+    assert struck == [5]
+    assert 5 in led.ejected
+    assert led.blocked(100) == {5}        # ejection never expires
+    assert led.quarantined(6) == 0        # ejected, not "benched"
+    # further strikes on an ejected client are not double-counted
+    assert led.observe(6, [5], [False]) == []
+    assert led.total_strikes == 2
+
+
+def test_ledger_finite_rounds_never_strike():
+    led = QuarantineLedger()
+    for rnd in range(1, 50):
+        assert led.observe(rnd, [0, 1, 2], [True, True, True]) == []
+    assert led.total_strikes == 0 and not led.blocked(50)
+
+
+def test_ledger_snapshot_and_digest():
+    led = QuarantineLedger(backoff=8, strikes=3)
+    snap = led.snapshot(1)
+    assert snap == {"quarantined": 0, "ejected": 0,
+                    "quarantine_ids_digest": None}
+    led.observe(1, [3, 9], [False, False])
+    snap = led.snapshot(2)
+    assert snap["quarantined"] == 2 and snap["ejected"] == 0
+    digest = snap["quarantine_ids_digest"]
+    assert digest.startswith("2:") and len(digest) == 2 + 12
+    # digest is stable for the same blocked set
+    assert led.snapshot(3)["quarantine_ids_digest"] == digest
+
+
+def test_ledger_validation():
+    with pytest.raises(ValueError):
+        QuarantineLedger(backoff=0)
+    with pytest.raises(ValueError):
+        QuarantineLedger(strikes=0)
+
+
+def test_mask_blocked_masks_slots_never_mutates():
+    rnd = Round(np.asarray([4, 5, 6, 7]),
+                np.zeros((4, 3), np.int64), np.ones((4, 3), bool))
+    out = mask_blocked(rnd, {5, 7})
+    np.testing.assert_array_equal(out.mask[:, 0],
+                                  [True, False, True, False])
+    assert rnd.mask.all()                 # original untouched
+    assert mask_blocked(rnd, set()) is rnd
+    assert mask_blocked(rnd, {99}) is rnd
+
+
+# ----------------------------------------------------- runtime semantics
+
+
+def test_quarantine_preserves_local_state_rows():
+    """local_topk with local error rows: a struck client's persistent
+    row must keep its PREVIOUS value, not absorb the nonfinite round."""
+    rt = make_runtime(mode="local_topk", error_type="local",
+                      local_momentum=0.9, k=5,
+                      adversary="nan", adversary_frac=0.4, seed=3,
+                      nonfinite_action="quarantine", fused_clients=False)
+    adv = np.asarray(rt._adv_universe)
+    assert adv.any() and not adv.all()
+    batch, mask, ids = make_batch()       # ids = arange(W)
+    state = rt.init_state()
+    err0 = np.asarray(state.client_errors)
+    state, m = rt.round(state, ids, batch, mask, 0.05)
+    fin = np.asarray(m["client_finite"])
+    np.testing.assert_array_equal(fin, ~adv[:W])
+    errs = np.asarray(state.client_errors)
+    vels = np.asarray(state.client_velocities)
+    assert np.isfinite(errs).all() and np.isfinite(vels).all()
+    # struck clients kept their (zero-initialized) rows; finite clients
+    # actually accumulated something
+    for i in range(W):
+        if not fin[i]:
+            np.testing.assert_array_equal(errs[i], err0[i])
+        else:
+            assert np.abs(errs[i]).sum() > 0
+
+
+def test_fully_nonfinite_round_still_aborts():
+    rt = make_runtime(mode="uncompressed", error_type="none",
+                      adversary="nan", adversary_frac=1.0,
+                      nonfinite_action="quarantine", fused_clients=False)
+    batch, mask, ids = make_batch()
+    state, m = rt.round(rt.init_state(), ids, batch, mask, 0.05)
+    assert int(state.nan_round) >= 0
+    assert not np.asarray(m["client_finite"]).any()
+
+
+def test_all_live_nonfinite_with_benched_slots_still_aborts():
+    """A benched/masked placeholder slot uploads finite zeros — it must
+    not vouch for a round whose every DATA-CARRYING upload diverged
+    ("fully-nonfinite" counts live clients, not slots)."""
+    rt = make_runtime(mode="uncompressed", error_type="none",
+                      adversary="nan", adversary_frac=1.0,
+                      nonfinite_action="quarantine", fused_clients=False)
+    batch, mask, ids = make_batch()
+    half = np.asarray(mask).copy()
+    half[: W // 2] = False                 # half the slots carry no data
+    state, m = rt.round(rt.init_state(), ids, batch,
+                        jnp.asarray(half), 0.05)
+    assert int(state.nan_round) >= 0
+    # the inverse: a round of ONLY zero-data slots has no nonfinite
+    # evidence — it must NOT abort as diverged (the driver's
+    # quarantine_exhausted path owns the no-data-left case)
+    state2, _ = rt.round(rt.init_state(), ids, batch,
+                         jnp.zeros_like(mask), 0.05)
+    assert int(state2.nan_round) < 0
+
+
+# ------------------------------------------------------- driver contract
+
+
+def _nan_runtime(**kw):
+    """A runtime whose universe provably contains >= 1 nan adversary
+    among StubDS's 8 clients."""
+    rt = make_runtime(dataset_name="SYNTH", telemetry_every=1,
+                      adversary="nan", adversary_frac=0.3, **kw)
+    assert np.asarray(rt._adv_universe).any()
+    return rt
+
+
+def test_driver_quarantine_completes_where_abort_stops(tmp_path):
+    """The acceptance criterion, end to end through cv_train.train: the
+    same nan-injecting population under --nonfinite_action quarantine
+    completes the run (schema-valid v5 defense events in the stream,
+    finite final loss) where the default abort stops it."""
+    rt = _nan_runtime(nonfinite_action="quarantine",
+                      quarantine_backoff=2, quarantine_strikes=2)
+    tel = RunTelemetry(str(tmp_path / "q"), "cv_train", cfg=rt.cfg)
+    tel.instrument(rt)
+    cfg = rt.cfg.replace(num_epochs=1.0, pivot_epoch=0.5)
+    state, summary = cv_train.train(cfg, rt, rt.init_state(), StubDS(),
+                                    StubDS(), loggers=(TableLogger(),),
+                                    telemetry=tel)
+    tel.close()
+    assert summary is not None            # the run COMPLETED
+    assert np.isfinite(summary["train_loss"])
+    assert np.isfinite(np.asarray(state.ps_weights)).all()
+    assert validate_file(tel.path) == []
+    events = read_events(tel.path)
+    defs = [e for e in events if e["event"] == "defense"]
+    assert defs, "no defense events in the quarantine stream"
+    assert any((e.get("nonfinite_clients") or 0) > 0 for e in defs)
+    assert any(e.get("quarantined", 0) > 0 or e.get("ejected", 0) > 0
+               for e in defs)
+    assert events[-1]["event"] == "summary" and not events[-1]["aborted"]
+
+    # the SAME population under the default abort stops the run
+    rt2 = _nan_runtime()
+    tel2 = RunTelemetry(str(tmp_path / "a"), "cv_train", cfg=rt2.cfg)
+    tel2.instrument(rt2)
+    cfg2 = rt2.cfg.replace(num_epochs=1.0, pivot_epoch=0.5)
+    state2, summary2 = cv_train.train(cfg2, rt2, rt2.init_state(),
+                                      StubDS(), StubDS(),
+                                      loggers=(TableLogger(),),
+                                      telemetry=tel2)
+    tel2.close()
+    assert summary2 is None               # aborted
+    kinds2 = [e["event"] for e in read_events(tel2.path)]
+    assert "nan_abort" in kinds2
+
+
+def test_driver_aborts_when_every_client_ejected(tmp_path, capsys):
+    """A fleet that ejects its ENTIRE universe has no data source left:
+    the run must terminate (aborted summary, critical alert) instead of
+    silently burning the budget on fully-masked rounds reporting
+    loss 0."""
+    rt = make_runtime(dataset_name="SYNTH", telemetry_every=1,
+                      adversary="nan", adversary_frac=1.0,
+                      nonfinite_action="quarantine",
+                      quarantine_backoff=1, quarantine_strikes=1)
+    assert np.asarray(rt._adv_universe).all()
+    tel = RunTelemetry(str(tmp_path), "cv_train", cfg=rt.cfg)
+    tel.instrument(rt)
+    cfg = rt.cfg.replace(num_epochs=3.0, pivot_epoch=1.0, do_test=False)
+    state, summary = cv_train.train(cfg, rt, rt.init_state(), StubDS(),
+                                    StubDS(), loggers=(TableLogger(),),
+                                    telemetry=tel)
+    tel.close()
+    assert summary is None                # terminated, not "successful"
+    assert "QUARANTINE ABORT" in capsys.readouterr().out
+    events = read_events(tel.path)
+    alerts = [e for e in events if e["event"] == "alert"]
+    assert any(a["rule"] == "quarantine_exhausted"
+               and a["severity"] == "critical" for a in alerts)
+    assert events[-1]["event"] == "summary" and events[-1]["aborted"]
+
+
+def test_driver_benches_struck_client_next_rounds(tmp_path, capsys):
+    """After a strike the client's later slots are masked out: its
+    nonfinite count drops to zero while it sits out (the ledger is
+    wired into the dispatch path, not just the stream)."""
+    rt = _nan_runtime(nonfinite_action="quarantine",
+                      quarantine_backoff=50, quarantine_strikes=3)
+    tel = RunTelemetry(str(tmp_path), "cv_train", cfg=rt.cfg)
+    tel.instrument(rt)
+    # several epochs so the adversary is re-sampled after its strike
+    cfg = rt.cfg.replace(num_epochs=3.0, pivot_epoch=1.0, do_test=False)
+    state, summary = cv_train.train(cfg, rt, rt.init_state(), StubDS(),
+                                    StubDS(), loggers=(TableLogger(),),
+                                    telemetry=tel)
+    tel.close()
+    assert summary is not None
+    assert "QUARANTINE: client" in capsys.readouterr().err
+    events = read_events(tel.path)
+    defs = [e for e in events if e["event"] == "defense"]
+    struck_rounds = [e["round"] for e in defs
+                     if (e.get("nonfinite_clients") or 0) > 0]
+    benched = [e for e in defs if e.get("quarantined", 0) > 0]
+    assert struck_rounds and benched
+    # with a 50-round backoff the client strikes ONCE and stays benched:
+    # every later defense record shows zero fresh nonfinites
+    after = [e for e in defs if e["round"] > struck_rounds[0]]
+    assert after and all((e.get("nonfinite_clients") or 0) == 0
+                         for e in after)
+    # the injected count reports ACTUAL injections: a benched hostile
+    # client's slot is masked and uploads nothing, so once the hostile
+    # population sits out the count must read zero — counting sampled
+    # hostile ids would fake ongoing injection in the stream
+    assert all(sum(e["injected"].values()) == 0 for e in after)

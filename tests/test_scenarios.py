@@ -93,6 +93,34 @@ def test_invalid_parameters_raise():
         StragglerScenario("uniform", latency=-1.0)
 
 
+def test_constructor_numerics_validated_exhaustively():
+    """The PR-7 satellite: every numeric field refuses its degenerate
+    range with a clear message — bad values must never silently produce
+    degenerate fates."""
+    with pytest.raises(ValueError, match="latency/spread"):
+        StragglerScenario("uniform", spread=-0.5)
+    with pytest.raises(ValueError, match="dropout"):
+        StragglerScenario("none", dropout=-0.1)
+    with pytest.raises(ValueError, match="participation"):
+        StragglerScenario("none", participation=1.5)
+    with pytest.raises(ValueError, match="participation"):
+        StragglerScenario("none", participation=-0.2)
+    with pytest.raises(ValueError, match="straggler_frac"):
+        StragglerScenario("stragglers", straggler_frac=1.2)
+    with pytest.raises(ValueError, match="straggler_frac"):
+        StragglerScenario("stragglers", straggler_frac=-0.1)
+    # straggler_mult < 1 makes the "stragglers" FASTER than the rest —
+    # a silently-inverted two-point mixture (the named regression)
+    with pytest.raises(ValueError, match="straggler_mult"):
+        StragglerScenario("stragglers", straggler_mult=0.5)
+    with pytest.raises(ValueError, match="straggler_mult"):
+        StragglerScenario("none", straggler_mult=0.0)
+    # the boundary values remain legal
+    StragglerScenario("stragglers", straggler_mult=1.0,
+                      straggler_frac=0.0, dropout=0.0, participation=1.0,
+                      latency=0.0, spread=0.0)
+
+
 def test_make_scenario_elides_trivial_and_builds_configured():
     cfg = FedConfig(async_agg=True)
     assert make_scenario(cfg) is None
