@@ -149,14 +149,24 @@ def run_cifar(result: dict, W: int = 8, B: int = 64,
     if telemetry is not None:
         # schema-validated utilization event in the shared stream: the
         # same MFU the JSON line carries, plus the starvation fractions
+        # and (v6) the roofline fields — the round executable's bytes
+        # accessed come from the JitWatcher's cost analysis (the warmup
+        # compiled through it), so AI/bound ride the same stream
         from commefficient_tpu.telemetry.utilization import emit_from_totals
-        emit_from_totals(
+        round_bytes = telemetry.watcher().bytes.get("round_step")
+        ufields = emit_from_totals(
             telemetry, rnd=n_rounds, rounds=n_rounds, wall_s=dt,
             host_s=phases["host_s"], dispatch_s=phases["dispatch_s"],
             device_s=phases["device_wait_s"],
             flops_per_round=(flops if np.isfinite(flops) else None),
             flops_source="cost_analysis",
-            device_kind=getattr(jax.devices()[0], "device_kind", "unknown"))
+            device_kind=getattr(jax.devices()[0], "device_kind", "unknown"),
+            bytes_per_round=(float(round_bytes) if round_bytes else None),
+            bytes_source="cost_analysis")
+        result["roofline"] = {
+            k: ufields[k] for k in ("bytes_per_round",
+                                    "arithmetic_intensity", "bound",
+                                    "bw_frac")}
         telemetry.bench_event(result["metric"], result)
 
 

@@ -16,12 +16,14 @@ from __future__ import annotations
 import sys
 import time
 
-# peak bf16 FLOP/s by generation — single source of truth in
-# telemetry/utilization.py (the `utilization` events and the benches
-# must agree on the MFU denominator); re-exported under the old name
+# peak bf16 FLOP/s and HBM GB/s by generation — single source of truth
+# in telemetry/utilization.py (the `utilization` events and the benches
+# must agree on the MFU/roofline denominators); re-exported under the
+# old name
 from commefficient_tpu.telemetry.utilization import (  # noqa: F401
     PEAK_FLOPS_BY_KIND as PEAK_FLOPS,
     peak_flops_for,
+    peak_hbm_for,
 )
 
 
@@ -35,6 +37,20 @@ def peak_flops(device) -> float:
     if peak is None:
         log(f"WARNING: unknown device kind {kind!r}; assuming v5e peak")
         return 197e12
+    return peak
+
+
+def peak_hbm_gbps(device) -> float:
+    """Roofline bandwidth denominator with the same assume-v5e fallback
+    as peak_flops: the bench headline must always carry a number (it is
+    labeled with the device kind), unlike the telemetry events whose
+    contract is null-never-fake (utilization.peak_hbm_for)."""
+    kind = getattr(device, "device_kind", "")
+    peak = peak_hbm_for(kind)
+    if peak is None:
+        log(f"WARNING: unknown device kind {kind!r}; assuming v5e HBM "
+            "bandwidth")
+        return 819.0
     return peak
 
 

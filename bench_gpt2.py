@@ -36,7 +36,7 @@ import json
 
 import numpy as np
 
-from bench_common import log, peak_flops, timed_rounds
+from bench_common import log, peak_flops, timed_rounds, with_retries
 # the analytic FLOPs formula moved next to the model so the gpt2_train
 # driver's utilization telemetry shares it (models/gpt2.py)
 from commefficient_tpu.models.gpt2 import gpt2_model_flops  # noqa: F401
@@ -49,7 +49,7 @@ NOMINAL_SINGLE_GPU_TOK_PER_SEC = 4500.0
 
 def run(remat: bool = True, telemetry=None, profiler=None, *,
         remat_policy: str = "", microbatch: int = 8, lm_chunk: int = 128,
-        n_rounds: int = 8, compile_cache=None) -> dict:
+        n_rounds: int = 8, compile_cache=None, dryrun: bool = False) -> dict:
     """Build, warm up and time the GPT-2 round; returns the result dict.
 
     ``remat=True`` is the shipping configuration. remat=False spends the
@@ -63,7 +63,13 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
     full remat and none, the microbatch/HBM trade, and the chunked-CE
     granularity — the three knobs runs/BREAKDOWN_gpt2.md names between
     the measured 33% and the 40% target. ``microbatch`` must divide the
-    8-dialogue client batch."""
+    dialogue client batch.
+
+    ``dryrun=True`` shrinks the model (GPT2Config.small) and the round
+    shape so every arm runs in seconds on the CPU container — the sweep
+    mechanics, compiled-executable cost/memory analysis and roofline
+    fields stay live while the throughput numbers are explicitly NOT
+    the flagship measurement (the result carries ``dryrun: true``)."""
     import jax
     import jax.numpy as jnp
 
@@ -73,16 +79,21 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
     from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
 
     log("devices:", jax.devices())
-    gcfg = GPT2Config(remat=remat, remat_policy=remat_policy)
+    if dryrun:
+        gcfg = GPT2Config.small(remat=remat, remat_policy=remat_policy)
+        W, B, NC, S = 4, 4, 2, 64
+    else:
+        gcfg = GPT2Config(remat=remat, remat_policy=remat_policy)
+        W, B, NC, S = 8, 8, 2, 256
     model = GPT2DoubleHeads(gcfg)
-    W, B, NC, S = 8, 8, 2, 256
     rng = np.random.RandomState(0)
+    V = gcfg.vocab_size
     batch = {
         "input_ids": jnp.asarray(
-            rng.randint(0, 50257, (W, B, NC, S)), jnp.int32),
+            rng.randint(0, V, (W, B, NC, S)), jnp.int32),
         "mc_token_ids": jnp.asarray(rng.randint(0, S, (W, B, NC)), jnp.int32),
         "lm_labels": jnp.asarray(
-            rng.randint(0, 50257, (W, B, NC, S)), jnp.int32),
+            rng.randint(0, V, (W, B, NC, S)), jnp.int32),
         "mc_label": jnp.asarray(rng.randint(0, NC, (W, B)), jnp.int32),
         "token_type_ids": jnp.asarray(
             rng.randint(0, 2, (W, B, NC, S)), jnp.int32),
@@ -91,13 +102,27 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
                         batch["input_ids"][0, :1], batch["mc_token_ids"][0, :1],
                         batch["token_type_ids"][0, :1])
 
+    if dryrun:
+        # microbatch keeps its RATIO meaning (arms sweep 2/4/8 over the
+        # full-scale client batch of 8; the dryrun batch is 4, so
+        # mb8 -> 4, mb4 -> 2, mb2 -> 1 — each arm still A/Bs a DISTINCT
+        # live-set size; a plain min-clamp would collapse mb8 and mb4
+        # into the same configuration) and the sketch shrinks with the
+        # model — the arm still exercises the same code paths, just at
+        # smoke scale
+        microbatch = max(1, (microbatch * B) // 8)
+        lm_chunk = min(lm_chunk, S)
+        sketch_kw = dict(k=1_000, num_rows=3, num_cols=16_384,
+                         num_blocks=2)
+    else:
+        sketch_kw = dict(k=50_000, num_rows=5, num_cols=524_288,
+                         num_blocks=20)
     cfg = FedConfig(mode="sketch", error_type="virtual", local_momentum=0.0,
                     virtual_momentum=0.9, weight_decay=0.0,
                     num_workers=W, local_batch_size=B,
                     microbatch_size=microbatch,
-                    k=50_000, num_rows=5, num_cols=524_288, num_blocks=20,
                     num_clients=100, track_bytes=False, approx_topk=True,
-                    num_results_train=2, lm_chunk=lm_chunk)
+                    num_results_train=2, lm_chunk=lm_chunk, **sketch_kw)
     if compile_cache is not None:  # "" = disable (true cold start)
         cfg = cfg.replace(compilation_cache_dir=compile_cache)
     enable_compilation_cache(cfg)
@@ -128,6 +153,53 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
     mfu = (flops * n_rounds / dt) / peak
     log(f"{n_rounds} rounds in {dt:.3f}s -> {tps:.0f} tok/s, loss {loss:.3f}")
     log(f"model FLOPs/round {flops:.3e}, peak {peak:.0f}, MFU {mfu:.3f}")
+
+    # roofline attribution of the compiled round: cost-analysis bytes
+    # accessed + the memory_analysis ledger (temp bytes DOCUMENT the
+    # dense-gradient materialization the sketch round still pays — see
+    # telemetry/memory_ledger.py SKETCH_ENCODE_FUSED). With telemetry on
+    # the JitWatcher already captured both at the warmup compile (and
+    # instrument() replaced runtime._round with the watcher's closure,
+    # which has no .lower) — read its channels like bench.py does; only
+    # the bare path pays a lower+compile, near-free under the persistent
+    # compile cache. NOTE the same scan caveat as flops: XLA's
+    # bytes-accessed counts each scan body once, so the measured
+    # arithmetic intensity is an UPPER bound for the scanned round.
+    nbytes = mledger = None
+    if telemetry is not None:
+        w = telemetry.watcher()
+        nbytes = w.bytes.get("round_step")
+        mledger = w.memory.get("round_step")
+    else:
+        def round_cost():
+            s0 = runtime.init_state()
+            compiled = runtime._round.lower(
+                s0, ids, batch, mask, jnp.asarray(0.1, jnp.float32),
+                runtime.cs).compile()
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            from commefficient_tpu.telemetry.memory_ledger import \
+                ledger_from_compiled
+            return cost.get("bytes accessed"), ledger_from_compiled(compiled)
+
+        try:
+            nbytes, mledger = with_retries(round_cost,
+                                           desc="gpt2 round cost")
+        except Exception as e:
+            log(f"WARNING: round cost/memory analysis unavailable ({e})")
+    from commefficient_tpu.telemetry.utilization import roofline_fields
+    from bench_common import peak_hbm_gbps as _peak_hbm
+    roof = roofline_fields(
+        rounds=n_rounds, wall_s=dt, flops_per_round=flops,
+        bytes_per_round=(float(nbytes) if nbytes else None),
+        bytes_source="cost_analysis",
+        peak_flops=peak, peak_hbm_gbps=_peak_hbm(jax.devices()[0]))
+    if roof["bound"] is not None:
+        log(f"roofline: AI {roof['arithmetic_intensity']:.1f} FLOP/B "
+            f"(ridge {roof['ridge_intensity']:.1f}) -> {roof['bound']}-"
+            f"bound, bw_frac {roof['bw_frac']}")
+
     result = {
         "metric": "gpt2_sketch_round_throughput",
         "value": round(tps, 1),
@@ -139,6 +211,9 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
         "warmup_s": warmup_s,
         "phase_split": phases,
         "input_wait_frac": round(phases["host_s"] / dt, 6),
+        "roofline": roof,
+        "memory_ledger": mledger,
+        "dryrun": dryrun,
         # the sweep knobs this arm ran under (scripts/gpt2_mfu_sweep.py)
         "config": {"remat": remat, "remat_policy": remat_policy,
                    "microbatch": microbatch, "lm_chunk": lm_chunk},
@@ -150,7 +225,9 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
             host_s=phases["host_s"], dispatch_s=phases["dispatch_s"],
             device_s=phases["device_wait_s"],
             flops_per_round=flops, flops_source="analytic",
-            device_kind=getattr(jax.devices()[0], "device_kind", "unknown"))
+            device_kind=getattr(jax.devices()[0], "device_kind", "unknown"),
+            bytes_per_round=(float(nbytes) if nbytes else None),
+            bytes_source="cost_analysis")
         telemetry.bench_event(result["metric"], result)
     return result
 

@@ -283,6 +283,12 @@ class FedConfig:
     # does not know (or to pin a different MFU denominator, e.g. fp32
     # peak on CPU smoke runs)
     peak_flops: float = 0.0
+    # peak HBM bandwidth in GB/s for roofline attribution
+    # (telemetry/utilization.py): 0 = look the device_kind up in the
+    # built-in per-generation table; set explicitly for chips the table
+    # does not know. Unknown chip + no override = null roofline fields
+    # in the utilization events (never a verdict against a guess).
+    peak_hbm_gbps: float = 0.0
     # compression-signal health diagnostics (telemetry/signals.py):
     # cheap on-device norms (aggregated gradient, EF accumulators,
     # update support, sketch collision proxies) computed inside the
@@ -837,6 +843,11 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                    help="peak FLOP/s of one accelerator for the MFU "
                         "accounting in `utilization` telemetry events; "
                         "0 = per-device_kind table "
+                        "(telemetry/utilization.py)")
+    p.add_argument("--peak_hbm_gbps", type=float, default=0.0,
+                   help="peak HBM bandwidth (GB/s) of one accelerator "
+                        "for the roofline attribution in `utilization` "
+                        "telemetry events; 0 = per-device_kind table "
                         "(telemetry/utilization.py)")
     p.add_argument("--no_signals", dest="signals", action="store_false",
                    default=True,

@@ -321,6 +321,7 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
     if telemetry is not None:
         tracer = tracing.install()
         util = UtilizationTracker(telemetry, peak_flops=cfg.peak_flops,
+                                  peak_hbm_gbps=cfg.peak_hbm_gbps,
                                   watcher=telemetry.watcher())
         if model_flops_per_round:
             # analytic MFU numerator (gpt2_train passes one: XLA's cost
@@ -557,6 +558,16 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                                 metric="defense.ejected",
                                 value=float(len(qledger.ejected)),
                                 action=cfg.alert_action)
+                            # final residency snapshot, then the bundle:
+                            # a quarantine-exhausted postmortem ships the
+                            # memory timeline (memory.json) like the
+                            # NaN-abort path does
+                            telemetry.memory_event("quarantine_exhausted")
+                            if recorder is not None:
+                                recorder.record(state, {
+                                    "rule": "quarantine_exhausted",
+                                    "round": int(global_round),
+                                    "ejected": len(qledger.ejected)})
                             telemetry.span_event(tracer)
                             telemetry.write_summary(
                                 aborted=True, n_rounds=rounds_run + 1,
@@ -753,6 +764,12 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                 # close the round window at the epoch boundary: the
                 # validation sweep below must not dilute the round MFU
                 util.emit(global_round)
+            if telemetry is not None:
+                # residency snapshot at the END of the round phase —
+                # the epoch_<n> snapshot below lands after validation,
+                # so its delta_peak_bytes attributes validation's
+                # high-water growth while this one owns the rounds'
+                telemetry.memory_event(f"rounds_{epoch + 1}")
             sums = (np.asarray(ep_sums) if ep_sums is not None
                     else np.zeros(5))
             train_time = timer()
@@ -776,6 +793,9 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                         rnd=nan_round if nan_round >= 0 else global_round,
                         rule="nonfinite_abort", severity="critical",
                         metric="loss", action=cfg.alert_action)
+                    # final residency snapshot BEFORE the bundle, so the
+                    # postmortem's memory.json timeline ends at the abort
+                    telemetry.memory_event("nan_abort")
                     if recorder is not None:
                         recorder.record(state, {
                             "rule": "nonfinite_abort", "reason": which,
@@ -860,6 +880,12 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
             if (ckpt_mgr is not None and cfg.checkpoint_every
                     and (epoch + 1) % cfg.checkpoint_every == 0):
                 ckpt_mgr.save(state, epoch + 1, meta={"summary": summary})
+                if telemetry is not None:
+                    # the third phase of the residency attribution:
+                    # delta_peak_bytes here is the checkpoint writer's
+                    # high-water contribution (host-side gathers of a
+                    # sharded state can spike device residency too)
+                    telemetry.memory_event(f"checkpoint_{epoch + 1}")
             if cfg.do_test:
                 break
 

@@ -21,6 +21,16 @@ from commefficient_tpu.telemetry.health import (MONITORED_KINDS,
                                                 FlightRecorder,
                                                 robust_z)
 from commefficient_tpu.telemetry.compilewatch import JitWatcher
+from commefficient_tpu.telemetry.memory_ledger import (MEMORY_KEYS,
+                                                       MEMORY_LEDGER_KEYS,
+                                                       ResidencyTracker,
+                                                       check_ceilings,
+                                                       check_dense_grad_floor,
+                                                       ledger_from_compiled,
+                                                       ledger_from_stats,
+                                                       residency_fields,
+                                                       round_memory_ceilings,
+                                                       round_memory_ledger)
 from commefficient_tpu.telemetry.profiling import (ProfilerWindow,
                                                    parse_profile_rounds)
 from commefficient_tpu.telemetry.run import RunTelemetry, maybe_create
@@ -34,9 +44,13 @@ from commefficient_tpu.telemetry.signals import (SIGNAL_KEYS, round_signals,
 from commefficient_tpu.telemetry.tracing import (NullTracer, SpanTracer,
                                                  span)
 from commefficient_tpu.telemetry.utilization import (PEAK_FLOPS_BY_KIND,
+                                                     PEAK_HBM_GBPS_BY_KIND,
+                                                     ROOFLINE_KEYS,
                                                      UtilizationTracker,
                                                      emit_from_totals,
-                                                     peak_flops_for)
+                                                     peak_flops_for,
+                                                     peak_hbm_for,
+                                                     roofline_fields)
 
 __all__ = [
     "CLIENT_STAT_KEYS",
@@ -69,7 +83,21 @@ __all__ = [
     "SpanTracer",
     "span",
     "PEAK_FLOPS_BY_KIND",
+    "PEAK_HBM_GBPS_BY_KIND",
+    "ROOFLINE_KEYS",
     "UtilizationTracker",
     "emit_from_totals",
     "peak_flops_for",
+    "peak_hbm_for",
+    "roofline_fields",
+    "MEMORY_KEYS",
+    "MEMORY_LEDGER_KEYS",
+    "ResidencyTracker",
+    "check_ceilings",
+    "check_dense_grad_floor",
+    "ledger_from_compiled",
+    "ledger_from_stats",
+    "residency_fields",
+    "round_memory_ceilings",
+    "round_memory_ledger",
 ]

@@ -55,6 +55,14 @@ class JitWatcher:
         # with the round's wall time; a recompile overwrites, so the
         # count always describes the executable that is actually running
         self.flops: Dict[str, Any] = {}
+        # latest cost-analysis bytes-accessed per watched name — the
+        # roofline denominator (arithmetic intensity = flops / bytes);
+        # same overwrite-on-recompile semantics as `flops`
+        self.bytes: Dict[str, Any] = {}
+        # latest memory_analysis ledger per watched name (memory_ledger
+        # .py) — the per-executable static byte inventory; the flight
+        # recorder ships the aborting executable's entry in memory.json
+        self.memory: Dict[str, Dict[str, Any]] = {}
 
     def wrap(self, name: str, fn: Callable) -> Callable:
         cache: Dict[Any, Any] = {}
@@ -64,6 +72,8 @@ class JitWatcher:
             self.n_compiles += 1
             if cost.get("flops"):
                 self.flops[name] = cost.get("flops")
+            if cost.get("bytes accessed"):
+                self.bytes[name] = cost.get("bytes accessed")
             self._telemetry.event(
                 "compile", name=name, n_compiles=n,
                 lower_s=round(lower_s, 6), compile_s=round(compile_s, 6),
@@ -106,6 +116,22 @@ class JitWatcher:
                             ledger_from_compiled
                         self._telemetry.collectives_event(
                             name, ledger_from_compiled(compiled))
+                    except Exception:
+                        pass
+                # memory ledger of the fresh executable (memory_analysis
+                # temp/argument/output/alias/generated-code bytes) —
+                # the per-executable HBM inventory, emitted next to the
+                # compile event like the collectives; a backend without
+                # memory_analysis yields no event, not an all-null one
+                if hasattr(self._telemetry, "memory_ledger_event"):
+                    try:
+                        from commefficient_tpu.telemetry.memory_ledger \
+                            import ledger_from_compiled as _mem_ledger
+                        mledger = _mem_ledger(compiled)
+                        if mledger is not None:
+                            self.memory[name] = mledger
+                            self._telemetry.memory_ledger_event(
+                                name, mledger)
                     except Exception:
                         pass
             try:

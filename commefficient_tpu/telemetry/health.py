@@ -51,7 +51,7 @@ from typing import Any, Dict, List, Optional
 SEVERITIES = ("info", "warn", "critical")
 # event kinds RunTelemetry forwards to an attached monitor
 MONITORED_KINDS = ("round", "signals", "utilization", "client_stats",
-                   "async_round", "defense")
+                   "async_round", "defense", "memory")
 
 # The rule table: each rule watches ONE field of ONE event kind.
 # kind="z" fires on a robust z-score breach of the rolling history
@@ -113,6 +113,19 @@ RULES = (
     dict(name="quarantine_growth", event="defense", field="quarantined",
          kind="z", direction="high", severity="warn",
          mad_floor_abs=0.5),
+    # HBM pressure (schema v6 memory events, telemetry/memory_ledger
+    # .py): the allocator high-water peak leaving its rolling envelope
+    # is the near-OOM precursor — a leak (an accidentally retained
+    # state copy, a growing host->device staging buffer) shows up as
+    # anomalous peak growth SNAPSHOTS before the allocator dies, which
+    # is when a flight-recorder bundle can still be written. A healthy
+    # run's peak is a near-constant after warm-up, so the relative MAD
+    # floor would be 2% of multi-GB = tens of MB of tolerated jitter
+    # already; the absolute floor (16 MiB) only guards tiny-model runs
+    # whose whole peak is smaller than allocator rounding.
+    dict(name="hbm_pressure", event="memory", field="peak_bytes",
+         kind="z", direction="high", severity="warn",
+         mad_floor_abs=16 * 2**20),
 )
 
 
@@ -311,7 +324,12 @@ class FlightRecorder:
       fails the run);
     - ``events.jsonl`` — the stream's last-N events (the RunTelemetry
       ring buffer), so the bundle replays without the full stream;
-    - ``alert.json`` — the firing alert's context.
+    - ``alert.json`` — the firing alert's context;
+    - ``memory.json`` — the residency timeline (the stream's last-N
+      ``memory`` snapshots, separately ring-buffered so round/span
+      traffic cannot rotate them out) plus the per-executable memory
+      ledgers of the watched compiled functions — an OOM postmortem
+      ships WHERE the bytes went, not just the weights.
 
     One-shot: the FIRST alert owns the bundle (the interesting state is
     the earliest anomalous one — later alerts describe decay of a run
@@ -340,6 +358,23 @@ class FlightRecorder:
                         f.write(json.dumps(ev) + "\n")
                     f.flush()
                     os.fsync(f.fileno())
+                # memory.json: residency timeline + per-executable
+                # ledgers (see class docstring). getattr-guarded — a
+                # minimal telemetry stand-in without the v6 memory
+                # machinery still gets the rest of the bundle.
+                watcher = getattr(self._telemetry, "_watcher", None)
+                mem = {
+                    "residency": list(getattr(self._telemetry,
+                                              "recent_memory", ())),
+                    "ledgers": dict(getattr(watcher, "memory", {})
+                                    if watcher is not None else {}),
+                }
+                if mem["residency"] or mem["ledgers"]:
+                    with open(os.path.join(self.path, "memory.json"),
+                              "w") as f:
+                        json.dump(mem, f, indent=1)
+                        f.flush()
+                        os.fsync(f.fileno())
                 # the stream itself must survive whatever comes next
                 self._telemetry.fsync()
             with open(os.path.join(self.path, "alert.json"), "w") as f:

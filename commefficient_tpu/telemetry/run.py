@@ -87,6 +87,14 @@ class RunTelemetry:
         # "last N events before it died" (telemetry/health.py); 256 covers
         # several record windows of every event type at trivial memory
         self.recent: collections.deque = collections.deque(maxlen=256)
+        # recent memory (residency) snapshots, separately ring-buffered:
+        # the flight recorder's memory.json wants a residency TIMELINE
+        # even when the main ring has long since rotated the early
+        # snapshots out under round/span traffic
+        self.recent_memory: collections.deque = collections.deque(maxlen=32)
+        # residency tracker (telemetry/memory_ledger.py): previous-peak
+        # state for delta attribution + the one-time CPU-degradation note
+        self._residency = None
         try:
             os.makedirs(logdir, exist_ok=True)
             self._file = open(self.path, "w")
@@ -158,6 +166,8 @@ class RunTelemetry:
         self._seq += 1
         self._counts[kind] = self._counts.get(kind, 0) + 1
         self.recent.append(record)
+        if kind == "memory":
+            self.recent_memory.append(record)
         if kind == "round":
             # last_round feeds nan_abort as "last record known FINITE":
             # a record whose loss/acc went non-finite (serialized null)
@@ -248,21 +258,23 @@ class RunTelemetry:
                    **{**s, **extra})
 
     def memory_event(self, phase: str) -> None:
-        """Per-device memory snapshot; best-effort everywhere (CPU
-        backends return no stats — the event still records the attempt,
-        plus the host RSS, so the stream shape is backend-independent)."""
+        """Per-device memory snapshot + derived residency fields (schema
+        v6, telemetry/memory_ledger.py): live/peak bytes, peak growth
+        since the previous snapshot (which PHASE grew the high-water),
+        fragmentation and headroom. Best-effort everywhere: a backend
+        without ``memory_stats`` (CPU) degrades every derived field to
+        null with a one-time stderr note — the event still records the
+        attempt plus the host RSS, so the stream shape is
+        backend-independent and null never means zero."""
         if self._file is None:
             return
         import jax
-        devices = []
-        for d in jax.devices():
-            try:
-                stats = d.memory_stats()
-            except Exception:
-                stats = None
-            devices.append({"id": int(d.id),
-                            "kind": getattr(d, "device_kind", "unknown"),
-                            "stats": _jsonable(stats) if stats else None})
+
+        from commefficient_tpu.telemetry.memory_ledger import \
+            ResidencyTracker
+        if self._residency is None:
+            self._residency = ResidencyTracker()
+        devices, derived = self._residency.snapshot(jax.devices())
         rss = None
         try:
             import resource
@@ -270,8 +282,22 @@ class RunTelemetry:
                    * 1024)  # linux reports KiB
         except Exception:
             pass
-        self.event("memory", phase=phase, devices=devices,
-                   host_rss_bytes=rss)
+        self.event("memory", phase=phase,
+                   devices=[{**d, "stats": _jsonable(d["stats"])
+                             if d["stats"] else None} for d in devices],
+                   host_rss_bytes=rss, **derived)
+
+    def memory_ledger_event(self, name: str,
+                            ledger: Dict[str, Any]) -> None:
+        """Static byte inventory of one compiled executable (schema v6,
+        telemetry/memory_ledger.py) — emitted by the JitWatcher next to
+        each `compile` event, so a buffer-size regression (a de-fusion
+        re-materializing per-client d-vectors) shows in every run's
+        stream, not only in the dryrun ceilings."""
+        from commefficient_tpu.telemetry.memory_ledger import \
+            MEMORY_LEDGER_KEYS
+        self.event("memory_ledger", name=name,
+                   **{k: ledger.get(k) for k in MEMORY_LEDGER_KEYS})
 
     def nan_abort(self, *, nan_round: int, reason: str, cfg) -> None:
         """The structured replacement for the bare 'TRAINING DIVERGED'

@@ -20,14 +20,18 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterable, List, Tuple
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 # streams written by older code stay readable: v1 lacks the span /
 # utilization event types (added in v2), v2 lacks client_stats / alert
 # (added in v3), v3 lacks async_round (added in v4), v4 lacks defense
-# (added in v5), but each is otherwise a subset of its successor — so
-# the validator accepts any supported manifest version. A version it
-# does not know is the error, not a version merely older than current.
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, SCHEMA_VERSION)
+# (added in v5), v5 lacks memory_ledger and the enriched memory /
+# utilization fields (added in v6 — the first version to ADD FIELDS to
+# existing event types; see FIELDS_SINCE_V6, which the validator only
+# requires of v6+ streams), but each is otherwise a subset of its
+# successor — so the validator accepts any supported manifest version.
+# A version it does not know is the error, not a version merely older
+# than current.
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, SCHEMA_VERSION)
 TELEMETRY_BASENAME = "telemetry.jsonl"
 
 
@@ -130,11 +134,41 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "bytes_accessed": _opt_num,
         "fallback": _bool,            # True: watcher gave up on AOT path
     },
-    # per-device memory_stats() snapshot (+ host RSS)
+    # per-device memory_stats() snapshot (+ host RSS). Schema v6 adds
+    # the derived residency fields (telemetry/memory_ledger.py
+    # residency_fields): max-over-devices live/peak bytes, the peak's
+    # growth since the PREVIOUS snapshot (which phase grew the
+    # high-water), fragmentation = peak - live, the device byte limit
+    # and the headroom fraction (limit - peak)/limit — the near-OOM
+    # precursor health.py's hbm_pressure rule watches. All null on
+    # backends without allocator stats (CPU) — never fake zeros.
     "memory": {
-        "phase": _str,                # init | round_1 | epoch_<n> | ...
+        "phase": _str,                # init | rounds_<n> | epoch_<n> | ...
         "devices": _list,             # [{id, kind, stats: dict|null}, ...]
         "host_rss_bytes": _opt_num,
+        "live_bytes": _opt_num,
+        "peak_bytes": _opt_num,
+        "delta_peak_bytes": _opt_num,
+        "fragmentation_bytes": _opt_num,
+        "limit_bytes": _opt_num,
+        "headroom_frac": _opt_num,
+    },
+    # static byte inventory of one compiled executable (schema v6,
+    # telemetry/memory_ledger.py, from XLA's memory_analysis): temp
+    # buffers (the working set — where a fusion regression or the
+    # sketch round's dense-gradient materialization shows up),
+    # argument/output/alias bytes (the resident state the executable
+    # touches) and generated-code bytes. Emitted by the JitWatcher next
+    # to each `compile` event; dryrun_multichip asserts hard ceilings.
+    # Fields are null when XLA reported no count — never fake zeros.
+    "memory_ledger": {
+        "name": _str,                 # watched function (round_step, ...)
+        "temp_bytes": _opt_num,
+        "argument_bytes": _opt_num,
+        "output_bytes": _opt_num,
+        "alias_bytes": _opt_num,
+        "generated_code_bytes": _opt_num,
+        "total_bytes": _opt_num,      # arg + output + temp + generated
     },
     # structured divergence diagnostic, emitted instead of a bare exit
     "nan_abort": {
@@ -206,6 +240,12 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
     # known — never a fake zero; the three *_frac fields are fractions
     # of wall_s and need not sum to 1 (device waits are only measured
     # on rounds that synced)
+    # schema v6 adds the roofline attribution fields (utilization.py
+    # roofline_fields): cost-analysis bytes-accessed joined with the
+    # FLOPs into arithmetic intensity, the ridge point of the pinned
+    # peak pair, a compute/bandwidth bound verdict, achieved-vs-peak
+    # bandwidth fraction and the two-term expected round time. Null
+    # whenever a byte count or a peak is unknown — never fake zeros.
     "utilization": {
         "round": _int,
         "rounds": _int,               # rounds in this window
@@ -220,6 +260,15 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "dispatch_frac": _opt_num,
         "device_wait_frac": _opt_num,
         "straggler_spread": _opt_num,  # (max-min)/mean per-host device_s
+        "peak_hbm_gbps": _opt_num,    # GB/s (--peak_hbm_gbps overrides)
+        "bytes_per_round": _opt_num,  # cost-analysis bytes accessed
+        "bytes_source": _opt_str,     # cost_analysis | null
+        "arithmetic_intensity": _opt_num,  # FLOPs per byte accessed
+        "ridge_intensity": _opt_num,  # peak_flops / peak_hbm bytes/s
+        "bound": _opt_str,            # compute | bandwidth | null
+        "achieved_gbps": _opt_num,    # bytes * rounds / wall_s, in GB/s
+        "bw_frac": _opt_num,          # achieved_gbps / peak_hbm_gbps
+        "expected_round_s": _opt_num,  # max(flops/peakF, bytes/peakBW)
     },
     # per-client population summary for one round (telemetry/clients.py):
     # on-device quantile reductions over the round's client axis (the
@@ -326,9 +375,27 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
 
 ENVELOPE = {"event": _str, "t": _num, "seq": _int}
 
+# fields ADDED to pre-existing event types in schema v6 (the residency
+# and roofline enrichments): a v1-v5 stream legitimately omits them, so
+# the validator only REQUIRES them of v6+ streams — but a pre-v6 stream
+# that does carry one must still type-check (forward-written fields are
+# ordinary extra fields otherwise).
+FIELDS_SINCE_V6: Dict[str, Tuple[str, ...]] = {
+    "memory": ("live_bytes", "peak_bytes", "delta_peak_bytes",
+               "fragmentation_bytes", "limit_bytes", "headroom_frac"),
+    "utilization": ("peak_hbm_gbps", "bytes_per_round", "bytes_source",
+                    "arithmetic_intensity", "ridge_intensity", "bound",
+                    "achieved_gbps", "bw_frac", "expected_round_s"),
+}
 
-def validate_event(obj: Any) -> List[str]:
-    """Return a list of problems with one decoded event (empty = valid)."""
+
+def validate_event(obj: Any,
+                   version: int = SCHEMA_VERSION) -> List[str]:
+    """Return a list of problems with one decoded event (empty = valid).
+    ``version`` is the stream's manifest schema version: fields added in
+    a later version than the stream claims are optional for it (see
+    FIELDS_SINCE_V6) — validate_lines threads the observed manifest
+    version through; standalone calls default to the current schema."""
     problems: List[str] = []
     if not isinstance(obj, dict):
         return [f"event is not an object: {type(obj).__name__}"]
@@ -344,8 +411,11 @@ def validate_event(obj: Any) -> List[str]:
     if spec is None:
         problems.append(f"unknown event type {kind!r}")
         return problems
+    v6_only = FIELDS_SINCE_V6.get(kind, ())
     for field, pred in spec.items():
         if field not in obj:
+            if version < 6 and field in v6_only:
+                continue
             problems.append(f"{kind}: missing field {field!r}")
         elif not pred(obj[field]):
             problems.append(
@@ -360,6 +430,7 @@ def validate_lines(lines: Iterable[str]) -> List[Tuple[int, str]]:
     must be a manifest with a SUPPORTED schema version."""
     problems: List[Tuple[int, str]] = []
     expected_seq = 0
+    version = SCHEMA_VERSION
     for lineno, line in enumerate(lines, start=1):
         line = line.strip()
         if not line:
@@ -369,7 +440,12 @@ def validate_lines(lines: Iterable[str]) -> List[Tuple[int, str]]:
         except ValueError as e:
             problems.append((lineno, f"not valid JSON: {e}"))
             continue
-        for p in validate_event(obj):
+        if (isinstance(obj, dict) and obj.get("event") == "manifest"
+                and obj.get("schema") in SUPPORTED_SCHEMA_VERSIONS):
+            # the stream's own vintage governs which per-event fields
+            # are required of it (see validate_event / FIELDS_SINCE_V6)
+            version = obj["schema"]
+        for p in validate_event(obj, version=version):
             problems.append((lineno, p))
         if isinstance(obj, dict):
             if expected_seq == 0 and obj.get("event") != "manifest":

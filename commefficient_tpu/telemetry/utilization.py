@@ -47,18 +47,114 @@ PEAK_FLOPS_BY_KIND = {
     "TPU v2": 45e12,
 }
 
+# peak HBM bandwidth in GB/s by generation (public spec sheets), same
+# prefix matching and same single-source rule as the FLOPs table — the
+# roofline's second axis. An unknown chip yields null bandwidth fields
+# (--peak_hbm_gbps overrides), never a guess.
+PEAK_HBM_GBPS_BY_KIND = {
+    "TPU v5 lite": 819.0,    # v5e
+    "TPU v5e": 819.0,
+    "TPU v5": 2765.0,        # v5p
+    "TPU v4": 1228.0,
+    "TPU v6 lite": 1640.0,   # v6e / Trillium
+    "TPU v3": 900.0,
+    "TPU v2": 700.0,
+}
+
+# roofline attribution fields added to the ``utilization`` event in
+# schema v6 — computed by roofline_fields below; scripts/teleview.py
+# mirrors these as literals for jax-free analysis, pinned by
+# tests/test_memory.py.
+ROOFLINE_KEYS = ("peak_hbm_gbps", "bytes_per_round", "bytes_source",
+                 "arithmetic_intensity", "ridge_intensity", "bound",
+                 "achieved_gbps", "bw_frac", "expected_round_s")
+
+
+def _peak_lookup(table, device_kind: str,
+                 override: float = 0.0) -> Optional[float]:
+    if override:
+        return float(override)
+    for name, peak in table.items():
+        if device_kind.startswith(name):
+            return peak
+    return None
+
 
 def peak_flops_for(device_kind: str,
                    override: float = 0.0) -> Optional[float]:
     """Peak FLOP/s for a device kind: the ``--peak_flops`` override when
     given, else the table (prefix match), else None — an unknown chip
     yields null MFU rather than a number computed against a guess."""
-    if override:
-        return float(override)
-    for name, peak in PEAK_FLOPS_BY_KIND.items():
-        if device_kind.startswith(name):
-            return peak
-    return None
+    return _peak_lookup(PEAK_FLOPS_BY_KIND, device_kind, override)
+
+
+def peak_hbm_for(device_kind: str,
+                 override: float = 0.0) -> Optional[float]:
+    """Peak HBM bandwidth (GB/s): the ``--peak_hbm_gbps`` override when
+    given, else the table (prefix match), else None — same
+    null-never-fake-zero contract as peak_flops_for."""
+    return _peak_lookup(PEAK_HBM_GBPS_BY_KIND, device_kind, override)
+
+
+def roofline_fields(*, rounds: int, wall_s: float,
+                    flops_per_round: Optional[float],
+                    bytes_per_round: Optional[float],
+                    bytes_source: Optional[str],
+                    peak_flops: Optional[float],
+                    peak_hbm_gbps: Optional[float]) -> Dict[str, Any]:
+    """Roofline attribution for one executable over one timed window:
+
+    - ``arithmetic_intensity`` = FLOPs / bytes accessed (FLOP/byte);
+    - ``ridge_intensity`` = peak FLOP/s / peak bytes/s — the intensity
+      where the roofline's two ceilings meet on THIS chip;
+    - ``bound``: ``compute`` when the intensity sits at/right of the
+      ridge (the FLOP ceiling binds), ``bandwidth`` left of it (the HBM
+      ceiling binds), null when either coordinate is unknown;
+    - ``achieved_gbps`` / ``bw_frac``: measured byte throughput and its
+      fraction of peak — the bandwidth analog of achieved_flops / mfu;
+    - ``expected_round_s``: the two-term time model
+      max(flops/peak_flops, bytes/peak_bw) — the executable's floor
+      under perfect overlap; wall clock above it is overhead
+      (dispatch, serialization, under-utilized units), below it means
+      the byte or FLOP count under-describes the executable.
+
+    Every field is null when an input it needs is unknown — a roofline
+    verdict computed against a guessed peak would be exactly the
+    back-of-envelope arithmetic this module exists to replace."""
+    peak_bw = peak_hbm_gbps * 1e9 if peak_hbm_gbps else None
+    ai = (flops_per_round / bytes_per_round
+          if flops_per_round and bytes_per_round else None)
+    ridge = (peak_flops / peak_bw if peak_flops and peak_bw else None)
+    bound = None
+    if ai is not None and ridge is not None:
+        bound = "compute" if ai >= ridge else "bandwidth"
+    achieved_bps = (bytes_per_round * rounds / wall_s
+                    if bytes_per_round and wall_s > 0 else None)
+    t_flops = (flops_per_round / peak_flops
+               if flops_per_round and peak_flops else None)
+    t_bytes = (bytes_per_round / peak_bw
+               if bytes_per_round and peak_bw else None)
+    expected = (max(t_flops, t_bytes)
+                if t_flops is not None and t_bytes is not None else None)
+
+    def sig(v, figs=6):
+        # significant figures like mfu: tiny true values must not
+        # round to a dishonest 0.0
+        return float(f"{v:.{figs}g}") if v is not None else None
+
+    return {
+        "peak_hbm_gbps": peak_hbm_gbps,
+        "bytes_per_round": bytes_per_round,
+        "bytes_source": bytes_source if bytes_per_round else None,
+        "arithmetic_intensity": sig(ai),
+        "ridge_intensity": sig(ridge),
+        "bound": bound,
+        "achieved_gbps": sig(achieved_bps / 1e9
+                             if achieved_bps is not None else None),
+        "bw_frac": sig(achieved_bps / peak_bw
+                       if achieved_bps is not None and peak_bw else None),
+        "expected_round_s": sig(expected),
+    }
 
 
 def _frac(part: float, whole: float) -> Optional[float]:
@@ -84,9 +180,15 @@ def utilization_fields(*, rounds: int, wall_s: float,
                        flops_source: Optional[str],
                        device_kind: str,
                        peak_flops: Optional[float],
-                       spread: Optional[float] = None) -> Dict[str, Any]:
+                       spread: Optional[float] = None,
+                       bytes_per_round: Optional[float] = None,
+                       bytes_source: Optional[str] = None,
+                       peak_hbm_gbps: Optional[float] = None
+                       ) -> Dict[str, Any]:
     """The pure MFU/starvation math, separated from event emission so
-    tests can drive it with synthetic cost dicts and fake peak tables."""
+    tests can drive it with synthetic cost dicts and fake peak tables.
+    Schema v6: joins the roofline attribution (roofline_fields) when a
+    byte count / bandwidth peak is supplied — null fields otherwise."""
     achieved = mfu = None
     if flops_per_round and wall_s > 0:
         achieved = flops_per_round * rounds / wall_s
@@ -107,6 +209,12 @@ def utilization_fields(*, rounds: int, wall_s: float,
         "dispatch_frac": _frac(dispatch_s, wall_s),
         "device_wait_frac": _frac(device_s, wall_s),
         "straggler_spread": spread,
+        **roofline_fields(rounds=rounds, wall_s=wall_s,
+                          flops_per_round=flops_per_round,
+                          bytes_per_round=bytes_per_round,
+                          bytes_source=bytes_source,
+                          peak_flops=peak_flops,
+                          peak_hbm_gbps=peak_hbm_gbps),
     }
 
 
@@ -117,7 +225,10 @@ def emit_from_totals(telemetry, *, rnd: int, rounds: int, wall_s: float,
                      flops_source: Optional[str] = None,
                      device_kind: str = "unknown",
                      peak_flops: float = 0.0,
-                     per_host_device_s: Optional[List[float]] = None
+                     per_host_device_s: Optional[List[float]] = None,
+                     bytes_per_round: Optional[float] = None,
+                     bytes_source: Optional[str] = None,
+                     peak_hbm_gbps: float = 0.0
                      ) -> Dict[str, Any]:
     """One-shot ``utilization`` event from aggregate totals (the bench
     path: one event per timed stage). Returns the computed fields so the
@@ -127,7 +238,9 @@ def emit_from_totals(telemetry, *, rnd: int, rounds: int, wall_s: float,
         device_s=device_s, flops_per_round=flops_per_round,
         flops_source=flops_source, device_kind=device_kind,
         peak_flops=peak_flops_for(device_kind, peak_flops),
-        spread=straggler_spread(per_host_device_s or []))
+        spread=straggler_spread(per_host_device_s or []),
+        bytes_per_round=bytes_per_round, bytes_source=bytes_source,
+        peak_hbm_gbps=peak_hbm_for(device_kind, peak_hbm_gbps))
     if telemetry is not None:
         telemetry.event("utilization", round=int(rnd), **fields)
     return fields
@@ -149,7 +262,8 @@ class UtilizationTracker:
 
     def __init__(self, telemetry, *, device_kind: Optional[str] = None,
                  peak_flops: float = 0.0, watcher=None,
-                 watch_name: str = "round_step"):
+                 watch_name: str = "round_step",
+                 peak_hbm_gbps: float = 0.0):
         self._telemetry = telemetry
         self._watcher = watcher
         self._watch_name = watch_name
@@ -164,6 +278,12 @@ class UtilizationTracker:
             print(f"WARNING: no peak-FLOPs entry for device kind "
                   f"{device_kind!r}; utilization events will carry null "
                   "mfu (set --peak_flops to override)", file=sys.stderr)
+        self.peak_hbm_gbps = peak_hbm_for(device_kind, peak_hbm_gbps)
+        if self.peak_hbm_gbps is None:
+            print(f"WARNING: no peak-HBM-bandwidth entry for device kind "
+                  f"{device_kind!r}; utilization events will carry null "
+                  "roofline fields (set --peak_hbm_gbps to override)",
+                  file=sys.stderr)
         self._flops: Optional[float] = None
         self._flops_source: Optional[str] = None
         self._reset()
@@ -188,6 +308,17 @@ class UtilizationTracker:
             flops = getattr(self._watcher, "flops", {}).get(self._watch_name)
             if flops:
                 return float(flops), "cost_analysis"
+        return None, None
+
+    def _bytes_per_round(self) -> Tuple[Optional[float], Optional[str]]:
+        """Roofline byte numerator: the watched executable's
+        cost-analysis bytes-accessed (compilewatch.JitWatcher records it
+        per compile). No analytic override — there is no closed-form
+        bytes count the way there is for FLOPs; null when unknown."""
+        if self._watcher is not None:
+            b = getattr(self._watcher, "bytes", {}).get(self._watch_name)
+            if b:
+                return float(b), "cost_analysis"
         return None, None
 
     def observe_round(self, *, host_s: float, dispatch_s: float,
@@ -215,12 +346,15 @@ class UtilizationTracker:
             return None
         wall = time.perf_counter() - self._win_t0
         flops, source = self._flops_per_round()
+        nbytes, bsource = self._bytes_per_round()
         fields = utilization_fields(
             rounds=self._rounds, wall_s=wall, host_s=self._host_s,
             dispatch_s=self._dispatch_s, device_s=self._device_s,
             flops_per_round=flops, flops_source=source,
             device_kind=self.device_kind, peak_flops=self.peak_flops,
-            spread=straggler_spread(self._per_host))
+            spread=straggler_spread(self._per_host),
+            bytes_per_round=nbytes, bytes_source=bsource,
+            peak_hbm_gbps=self.peak_hbm_gbps)
         self._telemetry.event("utilization", round=int(rnd), **fields)
         self._reset()
         return fields

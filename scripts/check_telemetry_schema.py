@@ -49,6 +49,33 @@ _SAMPLE_OVERRIDES = {
               {"name": "round_dispatch", "ts": 0.01, "dur_s": 0.02,
                "tid": 0, "depth": 1}],
     "flops_source": "cost_analysis",
+    # schema-v6 roofline enrichment of the utilization event: one
+    # realistic bandwidth-bound window (AI below the v5e ridge)
+    "bytes_source": "cost_analysis",
+    "bound": "bandwidth",
+    "peak_hbm_gbps": 819.0,
+    "bytes_per_round": 4.0e9,
+    "arithmetic_intensity": 55.0,
+    "ridge_intensity": 240.5,
+    "achieved_gbps": 500.0,
+    "bw_frac": 0.61,
+    "expected_round_s": 0.0049,
+    # schema-v6 residency enrichment of the memory event (a healthy
+    # snapshot with headroom) — null on CPU streams, see memory_ledger
+    "live_bytes": 9.0e9,
+    "peak_bytes": 1.1e10,
+    "delta_peak_bytes": 2.0e8,
+    "fragmentation_bytes": 2.0e9,
+    "limit_bytes": 1.6e10,
+    "headroom_frac": 0.3125,
+    # memory_ledger: one realistic executable inventory (temp carrying
+    # a dense-gradient-sized buffer, the committed sketch-round shape)
+    "temp_bytes": 2.9e9,
+    "argument_bytes": 1.2e9,
+    "output_bytes": 1.2e9,
+    "alias_bytes": 1.1e9,
+    "generated_code_bytes": 4.0e6,
+    "total_bytes": 5.3e9,
     # client_stats: one realistic per-stat quantile record (ordered
     # quantiles, a null not-applicable stat) + participation fields
     "quantiles": {

@@ -30,6 +30,12 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_defense.py \
     tests/test_quarantine.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
+# memory ledger + roofline attribution: a regression here (broken
+# ledger parse, roofline math drift, ceiling-gate or residency-
+# degradation semantics) fails in seconds, before the full suite
+env JAX_PLATFORMS=cpu python -m pytest tests/test_memory.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

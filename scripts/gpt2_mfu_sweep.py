@@ -80,6 +80,14 @@ def main(argv=None) -> int:
                          "config default — strongly recommended, every "
                          "arm recompiles the round; empty string "
                          "disables)")
+    ap.add_argument("--dryrun", action="store_true",
+                    help="run every arm at smoke scale (GPT2Config.small"
+                         ", tiny round) so the sweep completes on the "
+                         "CPU container: exercises the sweep mechanics "
+                         "and records live roofline/memory-ledger "
+                         "fields per arm, but the throughput numbers "
+                         "are NOT the flagship measurement — each line "
+                         "carries dryrun: true")
     args = ap.parse_args(argv)
 
     import bench_gpt2
@@ -102,9 +110,11 @@ def main(argv=None) -> int:
         for name in names:
             log(f"=== arm {name}: {ARMS[name] or 'shipping config'}")
             rec = {"arm": name, **{"overrides": ARMS[name]}}
+            if args.dryrun:
+                rec["dryrun"] = True
             try:
                 rec["result"] = bench_gpt2.run(
-                    n_rounds=args.rounds,
+                    n_rounds=args.rounds, dryrun=args.dryrun,
                     compile_cache=args.compile_cache, **ARMS[name])
             except Exception as e:
                 log(traceback.format_exc())

@@ -9,8 +9,16 @@ CLI reads one stream (``summarize``/``alerts``/``clients``), two
     python scripts/teleview.py summarize runs/x/telemetry.jsonl
     python scripts/teleview.py alerts runs/x/telemetry.jsonl
     python scripts/teleview.py clients runs/x/telemetry.jsonl
+    python scripts/teleview.py memory runs/x/telemetry.jsonl
     python scripts/teleview.py diff old/telemetry.jsonl new/telemetry.jsonl
     python scripts/teleview.py timeline runs/x/telemetry.jsonl -o trace.json
+
+``memory`` (schema v6) renders the per-executable byte inventory
+(``memory_ledger`` events), the residency timeline (enriched ``memory``
+events: live/peak/fragmentation/headroom per phase) and the roofline
+table (arithmetic intensity, ridge, compute-vs-bandwidth bound verdict
+per ``utilization`` window); ``timeline`` adds hbm_live/peak_gib
+counter tracks from the same snapshots.
 
 ``summarize`` prints the manifest header, compile/collective inventory
 (per watched executable: launch counts by kind, payload bytes), a
@@ -50,7 +58,11 @@ chrome://tracing.
 - on async buffered-aggregation streams (schema v4), the final
   ``async_round`` staleness_mean rising more than ``--staleness_rise``
   (absolute, commits-stale units), or its post-commit error_norm
-  growing beyond ``--signal_ratio``x (staleness-induced EF divergence).
+  growing beyond ``--signal_ratio``x (staleness-induced EF divergence);
+- on schema-v6 streams, a watched executable's ``memory_ledger`` temp
+  bytes growing beyond ``--temp_bytes_growth``x (the de-fusion /
+  re-materialization regression class), or the final ``utilization``
+  ``bw_frac`` dropping more than ``--bw_frac_drop`` (absolute).
 
 Dependency-free (json + argparse), validates nothing itself — run
 ``scripts/check_telemetry_schema.py`` for schema enforcement.
@@ -69,14 +81,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 try:
     # single source of truth when the package is importable...
     from commefficient_tpu.telemetry.clients import CLIENT_STAT_KEYS
+    from commefficient_tpu.telemetry.memory_ledger import (
+        MEMORY_KEYS, MEMORY_LEDGER_KEYS)
     from commefficient_tpu.telemetry.schema import TELEMETRY_BASENAME
     from commefficient_tpu.telemetry.signals import SIGNAL_KEYS
+    from commefficient_tpu.telemetry.utilization import ROOFLINE_KEYS
 except ImportError:
     # ...but the analyzer must work on a machine WITHOUT jax (analyzing
     # a downloaded artifact is the whole point of an offline tool, and
     # the telemetry package import pulls jax in transitively). These
-    # literals mirror the canonical values; tests/test_signals.py and
-    # tests/test_clients.py pin them against the package.
+    # literals mirror the canonical values; tests/test_signals.py,
+    # tests/test_clients.py and tests/test_memory.py pin them against
+    # the package.
     TELEMETRY_BASENAME = "telemetry.jsonl"
     SIGNAL_KEYS = (
         "grad_norm", "grad_true_norm", "grad_l2estimate",
@@ -86,6 +102,19 @@ except ImportError:
     CLIENT_STAT_KEYS = (
         "loss", "grad_norm_pre", "grad_norm_post", "clip_frac",
         "tx_norm", "upload_bytes", "download_bytes",
+    )
+    MEMORY_KEYS = (
+        "live_bytes", "peak_bytes", "delta_peak_bytes",
+        "fragmentation_bytes", "limit_bytes", "headroom_frac",
+    )
+    MEMORY_LEDGER_KEYS = (
+        "temp_bytes", "argument_bytes", "output_bytes", "alias_bytes",
+        "generated_code_bytes", "total_bytes",
+    )
+    ROOFLINE_KEYS = (
+        "peak_hbm_gbps", "bytes_per_round", "bytes_source",
+        "arithmetic_intensity", "ridge_intensity", "bound",
+        "achieved_gbps", "bw_frac", "expected_round_s",
     )
 
 NORM_KEYS = ("grad_norm", "grad_true_norm", "grad_l2estimate",
@@ -147,6 +176,19 @@ def latest_collectives(events) -> Dict[str, Dict[str, Any]]:
     for e in by_kind(events, "collectives"):
         out[str(e.get("name"))] = e
     return out
+
+
+def latest_memory_ledgers(events) -> Dict[str, Dict[str, Any]]:
+    """name -> the LAST memory_ledger event per watched executable
+    (schema v6) — same recompile-overwrites semantics as collectives."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in by_kind(events, "memory_ledger"):
+        out[str(e.get("name"))] = e
+    return out
+
+
+def _gib(v) -> str:
+    return f"{v / 2**30:.3f} GiB" if isinstance(v, (int, float)) else "-"
 
 
 def _fin(v) -> Optional[float]:
@@ -222,6 +264,12 @@ def summarize(events: List[Dict[str, Any]], label: str = "") -> None:
             line += f", input wait {wait * 100:.1f}%"
         if spread is not None:
             line += f", straggler spread {spread:.3f}"
+        bound = u.get("bound")
+        bw = _fin(u.get("bw_frac"))
+        if bound is not None:
+            line += f", {bound}-bound"
+            if bw is not None:
+                line += f" (bw {bw * 100:.1f}% of peak)"
         print(line)
 
     sigs = by_kind(events, "signals")
@@ -443,6 +491,72 @@ def defense(events: List[Dict[str, Any]]) -> int:
     return 1 if ejected else 0
 
 
+# -------------------------------------------------------------------- memory
+
+
+def memory(events: List[Dict[str, Any]]) -> int:
+    """Memory report from the schema-v6 streams: the per-executable
+    byte inventory (``memory_ledger`` events — where a compiled round's
+    bytes STATICALLY go), the residency timeline (enriched ``memory``
+    events — what the allocator DYNAMICALLY held per phase, and which
+    phase grew the high-water), and the roofline table (``utilization``
+    events — whether each window was compute- or bandwidth-bound)."""
+    ledgers = latest_memory_ledgers(events)
+    mems = by_kind(events, "memory")
+    utils = by_kind(events, "utilization")
+    if not ledgers and not mems and not utils:
+        print("no memory_ledger/memory/utilization events (pre-v6 "
+              "stream, or --no_telemetry)")
+        return 0
+    if ledgers:
+        print("== per-executable byte inventory (memory_analysis, last "
+              "compile each)")
+        for name, e in sorted(ledgers.items()):
+            print(f"   {name}: temp {_gib(e.get('temp_bytes'))}, "
+                  f"args {_gib(e.get('argument_bytes'))}, "
+                  f"out {_gib(e.get('output_bytes'))}, "
+                  f"alias {_gib(e.get('alias_bytes'))}, "
+                  f"total {_gib(e.get('total_bytes'))}")
+    if mems:
+        any_resident = any(_fin(e.get("peak_bytes")) is not None
+                           for e in mems)
+        print(f"== residency timeline ({len(mems)} snapshots"
+              + ("" if any_resident
+                 else "; allocator stats unavailable on this backend — "
+                      "null means not measurable, not zero") + ")")
+        for e in mems:
+            delta = _fin(e.get("delta_peak_bytes"))
+            head = _fin(e.get("headroom_frac"))
+            print(f"   {str(e.get('phase', '?')):24s} "
+                  f"live {_gib(e.get('live_bytes'))} "
+                  f"peak {_gib(e.get('peak_bytes'))}"
+                  + (f" (+{_gib(delta)})" if delta is not None and delta > 0
+                     else "")
+                  + f" frag {_gib(e.get('fragmentation_bytes'))}"
+                  + (f" headroom {head * 100:.1f}%"
+                     if head is not None else ""))
+    if utils:
+        rows = [u for u in utils
+                if _fin(u.get("arithmetic_intensity")) is not None]
+        if rows:
+            print("== roofline (utilization windows with byte counts)")
+            for u in rows:
+                ai = _fin(u.get("arithmetic_intensity"))
+                ridge = _fin(u.get("ridge_intensity"))
+                bw = _fin(u.get("bw_frac"))
+                mfu = _fin(u.get("mfu"))
+                print(f"   r{u.get('round', '?'):>6}: AI {ai:.2f} FLOP/B"
+                      + (f" (ridge {ridge:.2f})" if ridge is not None
+                         else "")
+                      + f" -> {u.get('bound') or 'n/a'}"
+                      + (f", bw {bw * 100:.1f}%" if bw is not None else "")
+                      + (f", mfu {mfu:.3g}" if mfu is not None else ""))
+        else:
+            print("== roofline: utilization events carry no byte counts "
+                  "(no cost-analysis bytes, or pre-v6 stream)")
+    return 0
+
+
 # ------------------------------------------------------------------- timeline
 
 
@@ -479,6 +593,17 @@ def build_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         t, loss = _fin(e.get("t")), _fin(e.get("loss"))
         if t is not None and loss is not None:
             counters.append((t, "loss", loss))
+    for e in by_kind(events, "memory"):
+        # HBM counter track (schema v6): live + allocator-peak bytes in
+        # GiB per residency snapshot — the memory timeline next to the
+        # span slices, so an OOM trace shows WHEN the bytes arrived
+        t = _fin(e.get("t"))
+        if t is None:
+            continue
+        if _fin(e.get("live_bytes")) is not None:
+            counters.append((t, "hbm_live_gib", e["live_bytes"] / 2**30))
+        if _fin(e.get("peak_bytes")) is not None:
+            counters.append((t, "hbm_peak_gib", e["peak_bytes"] / 2**30))
 
     starts = [s[0] for s in slices] + [c[0] for c in counters]
     base = min(starts) if starts else 0.0
@@ -537,6 +662,20 @@ def diff(a: List[Dict[str, Any]], b: List[Dict[str, Any]],
                 f"collectives[{name}]: payload bytes {ba} -> {bb} "
                 f"(> {args.bytes_ratio:.2f}x)")
 
+    ma, mb = latest_memory_ledgers(a), latest_memory_ledgers(b)
+    for name in sorted(set(ma) & set(mb)):
+        # schema-v6 memory gate: temp-buffer growth is the de-fusion /
+        # re-materialization regression class (a per-client (W, d)
+        # gradient reappearing multiplies temp by the client count)
+        ta = _fin(ma[name].get("temp_bytes"))
+        tb = _fin(mb[name].get("temp_bytes"))
+        if ta is not None and tb is not None and ta > 0 \
+                and tb > ta * args.temp_bytes_growth:
+            problems.append(
+                f"memory_ledger[{name}]: temp bytes {ta:.0f} -> {tb:.0f} "
+                f"(> {args.temp_bytes_growth:.2f}x — a working-set "
+                "regression: something re-materialized)")
+
     sa, sb = by_kind(a, "signals"), by_kind(b, "signals")
     if sa and sb:
         for key in NORM_KEYS:
@@ -570,6 +709,14 @@ def diff(a: List[Dict[str, Any]], b: List[Dict[str, Any]],
                 f"utilization: input_wait_frac {wa:.3f} -> {wb:.3f} "
                 f"(rise > {args.starvation_rise:.2f} — the input "
                 "pipeline started starving the chip)")
+        fa = _fin(ua[-1].get("bw_frac"))
+        fb = _fin(ub[-1].get("bw_frac"))
+        if fa is not None and fb is not None \
+                and fb < fa - args.bw_frac_drop:
+            problems.append(
+                f"utilization: final bw_frac {fa:.3f} -> {fb:.3f} "
+                f"(drop > {args.bw_frac_drop:.2f} — achieved HBM "
+                "bandwidth regressed against the same peak)")
 
     aa, ab = by_kind(a, "async_round"), by_kind(b, "async_round")
     if aa and ab:
@@ -697,6 +844,15 @@ def main(argv=None) -> int:
                    help="max ABSOLUTE rise of the final async_round "
                         "staleness_mean (async buffered-aggregation "
                         "runs; commits-stale units)")
+    d.add_argument("--temp_bytes_growth", type=float, default=1.10,
+                   help="max growth factor of a watched executable's "
+                        "memory_ledger temp bytes (schema-v6 streams; "
+                        "the de-fusion/re-materialization regression "
+                        "class)")
+    d.add_argument("--bw_frac_drop", type=float, default=0.10,
+                   help="max ABSOLUTE drop of the final utilization "
+                        "bw_frac (achieved HBM bandwidth as a fraction "
+                        "of peak; schema-v6 streams)")
     d.add_argument("--clip_frac_rise", type=float, default=0.25,
                    help="max ABSOLUTE rise of the final defense "
                         "clip_frac (schema-v5 defense streams)")
@@ -721,6 +877,12 @@ def main(argv=None) -> int:
                         help="robustness report from the schema-v5 "
                              "defense stream (exit 1 on ejections)")
     de.add_argument("path")
+    me = sub.add_parser("memory",
+                        help="per-executable byte inventory, residency "
+                             "timeline and roofline table from the "
+                             "schema-v6 memory/memory_ledger/"
+                             "utilization streams")
+    me.add_argument("path")
     t = sub.add_parser("timeline",
                        help="render the span stream into a perfetto/"
                             "chrome-tracing trace.json")
@@ -737,6 +899,8 @@ def main(argv=None) -> int:
         return clients(load_events(args.path))
     if args.cmd == "defense":
         return defense(load_events(args.path))
+    if args.cmd == "memory":
+        return memory(load_events(args.path))
     if args.cmd == "timeline":
         return timeline(load_events(args.path), args.out)
     if args.cmd == "diff":

@@ -1,0 +1,619 @@
+"""HBM memory ledger + roofline attribution (telemetry/memory_ledger.py
++ the utilization.py roofline fields + the schema-v6 events): numpy-
+reference roofline math against synthetic cost dicts and fake peak
+tables, ledger parsing from a stubbed ``memory_analysis()``, the
+ceiling/dense-gradient gates in both directions, residency degradation
+semantics (missing method / raising / empty dict -> null, never fake
+zeros), schema round-trips incl. the v5-stream compatibility rule,
+JitWatcher stream integration, HLO invisibility of the whole layer, the
+``hbm_pressure`` monitor rule, the flight recorder's ``memory.json``,
+and the jax-free teleview literals + ``memory``/``diff`` gates."""
+
+import json
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.core import FedRuntime
+from commefficient_tpu.telemetry import (AnomalyMonitor, FlightRecorder,
+                                         RunTelemetry, validate_event,
+                                         validate_file)
+from commefficient_tpu.telemetry.memory_ledger import (MEMORY_KEYS,
+                                                       MEMORY_LEDGER_KEYS,
+                                                       ResidencyTracker,
+                                                       check_ceilings,
+                                                       check_dense_grad_floor,
+                                                       ledger_from_compiled,
+                                                       ledger_from_stats,
+                                                       residency_fields,
+                                                       round_memory_ceilings,
+                                                       round_memory_ledger)
+from commefficient_tpu.telemetry.utilization import (PEAK_HBM_GBPS_BY_KIND,
+                                                     ROOFLINE_KEYS,
+                                                     emit_from_totals,
+                                                     peak_hbm_for,
+                                                     roofline_fields,
+                                                     utilization_fields)
+
+W, B, D_IN, D_OUT = 4, 4, 6, 3
+D = D_IN * D_OUT
+
+
+def loss_fn(params, batch, mask):
+    pred = batch["x"] @ params["w"]
+    m = mask.astype(jnp.float32)
+    denom = jnp.maximum(m.sum(), 1.0)
+    err = ((pred - batch["y"]) ** 2).sum(axis=1)
+    loss = (err * m).sum() / denom
+    return loss, (loss,)
+
+
+def make_runtime(**kw):
+    cfg_kw = dict(mode="uncompressed", error_type="none",
+                  local_momentum=0.0, virtual_momentum=0.9,
+                  weight_decay=0.0, num_workers=W, local_batch_size=B,
+                  track_bytes=True, num_clients=8, num_results_train=2,
+                  num_results_val=2, k=5, num_rows=2, num_cols=32,
+                  exact_num_cols=True)
+    cfg_kw.update(kw)
+    params = {"w": jnp.asarray(
+        np.random.RandomState(0).randn(D_IN, D_OUT), jnp.float32)}
+    return FedRuntime(FedConfig(**cfg_kw), params, loss_fn, num_clients=8)
+
+
+def make_batch(seed=1):
+    rng = np.random.RandomState(seed)
+    batch = {"x": jnp.asarray(rng.randn(W, B, D_IN), jnp.float32),
+             "y": jnp.asarray(rng.randn(W, B, D_OUT), jnp.float32)}
+    return batch, jnp.ones((W, B), bool), jnp.arange(W, dtype=jnp.int32)
+
+
+# ------------------------------------------------------------- roofline
+
+
+def test_roofline_math_compute_bound():
+    # fake peak pair: 1e14 FLOP/s over 1000 GB/s -> ridge 100 FLOP/B;
+    # 1e12 FLOPs over 4e9 bytes -> AI 250, right of the ridge
+    r = roofline_fields(rounds=10, wall_s=2.0, flops_per_round=1e12,
+                        bytes_per_round=4e9, bytes_source="cost_analysis",
+                        peak_flops=1e14, peak_hbm_gbps=1000.0)
+    assert r["arithmetic_intensity"] == pytest.approx(250.0)
+    assert r["ridge_intensity"] == pytest.approx(100.0)
+    assert r["bound"] == "compute"
+    # bytes throughput: 4e9 * 10 / 2 s = 2e10 B/s = 20 GB/s of 1000
+    assert r["achieved_gbps"] == pytest.approx(20.0)
+    assert r["bw_frac"] == pytest.approx(0.02)
+    # two-term model: max(1e12/1e14, 4e9/1e12) = max(0.01, 0.004)
+    assert r["expected_round_s"] == pytest.approx(0.01)
+    assert r["bytes_source"] == "cost_analysis"
+
+
+def test_roofline_math_bandwidth_bound():
+    r = roofline_fields(rounds=1, wall_s=1.0, flops_per_round=1e11,
+                        bytes_per_round=4e9, bytes_source="cost_analysis",
+                        peak_flops=1e14, peak_hbm_gbps=1000.0)
+    assert r["arithmetic_intensity"] == pytest.approx(25.0)
+    assert r["bound"] == "bandwidth"
+    assert r["expected_round_s"] == pytest.approx(4e-3)  # byte term binds
+
+
+def test_roofline_null_contract_never_fake_zero():
+    # no byte count: every byte-derived field null, bytes_source nulled
+    r = roofline_fields(rounds=1, wall_s=1.0, flops_per_round=1e12,
+                        bytes_per_round=None, bytes_source="cost_analysis",
+                        peak_flops=1e14, peak_hbm_gbps=1000.0)
+    for k in ("bytes_per_round", "bytes_source", "arithmetic_intensity",
+              "bound", "achieved_gbps", "bw_frac", "expected_round_s"):
+        assert r[k] is None, k
+    assert r["ridge_intensity"] is not None  # peak pair alone defines it
+    # no bandwidth peak: verdict/ridge/fraction null even with bytes
+    r = roofline_fields(rounds=1, wall_s=1.0, flops_per_round=1e12,
+                        bytes_per_round=4e9, bytes_source="cost_analysis",
+                        peak_flops=1e14, peak_hbm_gbps=None)
+    for k in ("ridge_intensity", "bound", "bw_frac", "expected_round_s"):
+        assert r[k] is None, k
+    assert r["arithmetic_intensity"] == pytest.approx(250.0)
+
+
+def test_utilization_fields_joins_roofline():
+    f = utilization_fields(rounds=2, wall_s=1.0, host_s=0.1,
+                           dispatch_s=0.1, device_s=0.5,
+                           flops_per_round=1e12,
+                           flops_source="analytic", device_kind="fake",
+                           peak_flops=1e14, bytes_per_round=4e9,
+                           bytes_source="cost_analysis",
+                           peak_hbm_gbps=1000.0)
+    assert f["mfu"] == pytest.approx(0.02)
+    assert f["bound"] == "compute" and f["bw_frac"] is not None
+    # without bytes the roofline keys are still PRESENT (schema shape)
+    # but null — a pre-roofline caller keeps producing valid v6 events
+    f = utilization_fields(rounds=2, wall_s=1.0, host_s=0.1,
+                           dispatch_s=0.1, device_s=0.5,
+                           flops_per_round=1e12,
+                           flops_source="analytic", device_kind="fake",
+                           peak_flops=1e14)
+    for k in ROOFLINE_KEYS:
+        assert k in f
+    assert f["bound"] is None and f["arithmetic_intensity"] is None
+
+
+def test_peak_hbm_lookup_prefix_override_unknown():
+    assert peak_hbm_for("TPU v5 lite") == PEAK_HBM_GBPS_BY_KIND["TPU v5 lite"]
+    assert peak_hbm_for("TPU v4 (something)") == \
+        PEAK_HBM_GBPS_BY_KIND["TPU v4"]
+    assert peak_hbm_for("Grace Hopper") is None       # never a guess
+    assert peak_hbm_for("Grace Hopper", 4000.0) == 4000.0
+
+
+def test_emit_from_totals_roofline_event_round_trips(tmp_path):
+    tel = RunTelemetry(str(tmp_path), "test", cfg=None)
+    fields = emit_from_totals(
+        tel, rnd=1, rounds=4, wall_s=1.0, host_s=0.1, dispatch_s=0.1,
+        device_s=0.5, flops_per_round=1e12, flops_source="analytic",
+        device_kind="fake", peak_flops=1e14,
+        bytes_per_round=4e9, bytes_source="cost_analysis",
+        peak_hbm_gbps=1000.0)
+    tel.close()
+    assert fields["bound"] == "compute"
+    assert validate_file(tel.path) == []
+    ev = [json.loads(l) for l in open(tel.path)
+          if '"utilization"' in l][0]
+    for k in ROOFLINE_KEYS:
+        assert ev[k] == fields[k], k
+
+
+# ------------------------------------------------------- ledger parsing
+
+
+class _Stats:
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+def test_ledger_from_stats_full():
+    led = ledger_from_stats(_Stats(
+        temp_size_in_bytes=1000, argument_size_in_bytes=200,
+        output_size_in_bytes=300, alias_size_in_bytes=50,
+        generated_code_size_in_bytes=7))
+    assert led == {"temp_bytes": 1000, "argument_bytes": 200,
+                   "output_bytes": 300, "alias_bytes": 50,
+                   "generated_code_bytes": 7,
+                   "total_bytes": 1000 + 200 + 300 + 7}
+
+
+def test_ledger_from_stats_partial_keeps_nulls():
+    led = ledger_from_stats(_Stats(temp_size_in_bytes=64))
+    assert led["temp_bytes"] == 64 and led["argument_bytes"] is None
+    assert led["total_bytes"] == 64   # sum over the PRESENT parts only
+
+
+def test_ledger_from_stats_unknown_shape_is_none():
+    assert ledger_from_stats(_Stats()) is None
+    assert ledger_from_stats(_Stats(temp_size_in_bytes="big")) is None
+    # bool is an int subclass — must not be read as a byte count
+    assert ledger_from_stats(_Stats(temp_size_in_bytes=True)) is None
+
+
+def test_ledger_from_compiled_degrades_to_none():
+    class _Raises:
+        def memory_analysis(self):
+            raise NotImplementedError
+    assert ledger_from_compiled(_Raises()) is None
+    assert ledger_from_compiled(object()) is None   # no method at all
+
+
+def test_round_memory_ledger_real_executable():
+    """The CPU container's XLA exposes memory_analysis: the tiny round
+    must yield a ledger with real temp/argument bytes (the same call
+    the dryrun gate and the JitWatcher make)."""
+    rt = make_runtime()
+    batch, mask, ids = make_batch()
+    led = round_memory_ledger(rt, rt.init_state(), ids, batch, mask)
+    assert led is not None
+    assert led["temp_bytes"] and led["temp_bytes"] > 0
+    assert led["argument_bytes"] and led["argument_bytes"] > 0
+    assert led["total_bytes"] >= led["temp_bytes"]
+
+
+# ------------------------------------------------------------- ceilings
+
+
+def test_check_ceilings_pass_and_fail():
+    led = {"temp_bytes": 100, "argument_bytes": 50}
+    assert check_ceilings(led, {"temp_bytes": 200}) == []
+    assert check_ceilings(led, {"temp_bytes": 50}) != []
+    # a NULL measured field fails the gate — absence of evidence must
+    # not read as health (the collective-ledger lesson)
+    assert check_ceilings({"temp_bytes": None}, {"temp_bytes": 200}) != []
+    assert check_ceilings(None, {"temp_bytes": 200}) != []
+
+
+def test_dense_grad_floor_both_directions():
+    d = 100                        # floor = 400 bytes
+    assert check_dense_grad_floor({"temp_bytes": 500}, d,
+                                  fused=False) == []
+    assert check_dense_grad_floor({"temp_bytes": 300}, d,
+                                  fused=False) != []   # flip the flag!
+    assert check_dense_grad_floor({"temp_bytes": 300}, d,
+                                  fused=True) == []
+    assert check_dense_grad_floor({"temp_bytes": 500}, d,
+                                  fused=True) != []    # fusion regressed
+    assert check_dense_grad_floor({"temp_bytes": None}, d) != []
+    assert check_dense_grad_floor(None, d) != []
+
+
+def test_round_ceilings_hold_on_real_round_and_sketch_floor():
+    """Unit-scale pin of the dryrun gates: the tiny round sits under its
+    own geometry-derived ceilings, and the sketch round's temp buffers
+    contain the dense (d,) f32 gradient — the committed baseline
+    ROADMAP item 1's encode-fusion must flip."""
+    batch, mask, ids = make_batch()
+    for kw in (dict(),
+               dict(mode="sketch", error_type="virtual")):
+        rt = make_runtime(**kw)
+        state = rt.init_state()
+        led = round_memory_ledger(rt, state, ids, batch, mask)
+        assert check_ceilings(
+            led, round_memory_ceilings(rt, state, batch)) == []
+    # rt is the sketch runtime here
+    assert check_dense_grad_floor(led, rt.cfg.grad_size, fused=False) == []
+
+
+# ------------------------------------------------------------ residency
+
+
+def test_residency_fields_max_over_devices_and_derivations():
+    stats = [{"bytes_in_use": 100, "peak_bytes_in_use": 150,
+              "bytes_limit": 1000},
+             {"bytes_in_use": 300, "peak_bytes_in_use": 400,
+              "bytes_limit": 1000},
+             None]
+    f = residency_fields(stats, prev_peak=350)
+    assert f["live_bytes"] == 300 and f["peak_bytes"] == 400
+    assert f["delta_peak_bytes"] == 50
+    assert f["fragmentation_bytes"] == 100
+    assert f["limit_bytes"] == 1000
+    assert f["headroom_frac"] == pytest.approx(0.6)
+
+
+def test_residency_fields_all_null_without_stats():
+    f = residency_fields([None, {}, {"weird": 1}])
+    assert all(f[k] is None for k in MEMORY_KEYS)
+    # first snapshot: no previous peak -> delta null, not zero
+    f = residency_fields([{"peak_bytes_in_use": 10}], prev_peak=None)
+    assert f["delta_peak_bytes"] is None and f["peak_bytes"] == 10
+
+
+def test_residency_fields_derive_per_device_before_aggregating():
+    """Heterogeneous devices: fragmentation and headroom must describe a
+    REAL device, not pair the max peak with an independently-maxed
+    limit. Device 0 has twice the limit and the larger peak; device 1
+    is the one about to OOM — its ~1% headroom must win."""
+    stats = [{"bytes_in_use": 6 * 2**30, "peak_bytes_in_use": 8 * 2**30,
+              "bytes_limit": 16 * 2**30},
+             {"bytes_in_use": 7 * 2**30,
+              "peak_bytes_in_use": int(7.9 * 2**30),
+              "bytes_limit": 8 * 2**30}]
+    f = residency_fields(stats)
+    assert f["peak_bytes"] == 8 * 2**30          # worst absolute peak
+    assert f["limit_bytes"] == 16 * 2**30
+    # headroom: min over per-device (limit-peak)/limit = device 1's
+    assert f["headroom_frac"] == pytest.approx((8 - 7.9) / 8, abs=1e-6)
+    # fragmentation: max over per-device (peak-live), not max-peak minus
+    # max-live across different devices (which would be 1 GiB here)
+    assert f["fragmentation_bytes"] == 2 * 2**30
+
+
+class _Dev:
+    device_kind = "fake"
+
+    def __init__(self, id=0, stats=None, raises=False, missing=False):
+        self.id = id
+        self._stats, self._raises = stats, raises
+        if missing:
+            del self.memory_stats   # type: ignore[attr-defined]
+
+    def __getattr__(self, name):
+        raise AttributeError(name)
+
+    def memory_stats(self):
+        if self._raises:
+            raise RuntimeError("no allocator stats")
+        return self._stats
+
+
+def _dev_no_method(id=0):
+    class _Bare:
+        device_kind = "fake"
+    d = _Bare()
+    d.id = id
+    return d
+
+
+def test_residency_tracker_degrades_missing_method_and_empty(capsys):
+    """The satellite regression: a backend whose devices lack
+    ``memory_stats`` entirely, raise from it, or return an empty dict
+    must yield null fields with ONE stderr note — never fake zeros,
+    never a crash, never a per-snapshot nag."""
+    tr = ResidencyTracker()
+    for devs in ([_dev_no_method()],          # missing method
+                 [_Dev(raises=True)],         # raising method
+                 [_Dev(stats={})]):           # empty dict
+        records, derived = tr.snapshot(devs)
+        assert records[0]["stats"] is None
+        assert all(derived[k] is None for k in MEMORY_KEYS)
+    err = capsys.readouterr().err
+    assert err.count("memory_stats() unavailable") == 1   # one-time
+
+
+def test_residency_tracker_partial_stats_no_degradation_note(capsys):
+    """A backend exposing memory_stats() WITHOUT peak_bytes_in_use (live
+    only) keeps its non-null fields and must NOT be announced as
+    'unavailable' — the note is reserved for full absence."""
+    tr = ResidencyTracker()
+    _, derived = tr.snapshot([_Dev(stats={"bytes_in_use": 123})])
+    assert derived["live_bytes"] == 123
+    assert derived["peak_bytes"] is None
+    assert "memory_stats() unavailable" not in capsys.readouterr().err
+
+
+def test_residency_tracker_delta_attribution_across_snapshots():
+    tr = ResidencyTracker()
+    _, d1 = tr.snapshot([_Dev(stats={"bytes_in_use": 50,
+                                     "peak_bytes_in_use": 100})])
+    assert d1["delta_peak_bytes"] is None     # nothing to diff yet
+    _, d2 = tr.snapshot([_Dev(stats={"bytes_in_use": 60,
+                                     "peak_bytes_in_use": 180})])
+    assert d2["delta_peak_bytes"] == 80       # this phase grew the peak
+
+
+# --------------------------------------------------------------- schema
+
+
+def test_memory_ledger_event_schema_round_trip(tmp_path):
+    tel = RunTelemetry(str(tmp_path), "test", cfg=None)
+    tel.memory_ledger_event("round_step", {
+        "temp_bytes": 2_900_000_000, "argument_bytes": 1_200_000_000,
+        "output_bytes": 1_200_000_000, "alias_bytes": 1_100_000_000,
+        "generated_code_bytes": 4_000_000, "total_bytes": 5_304_000_000})
+    tel.memory_event("init")   # real devices; null-degrades on CPU
+    tel.close()
+    assert validate_file(tel.path) == []
+    events = [json.loads(l) for l in open(tel.path)]
+    ml = [e for e in events if e["event"] == "memory_ledger"]
+    assert len(ml) == 1 and ml[0]["name"] == "round_step"
+    assert ml[0]["temp_bytes"] == 2_900_000_000
+    mem = [e for e in events if e["event"] == "memory"]
+    assert len(mem) == 1
+    for k in MEMORY_KEYS:        # enriched fields present (possibly null)
+        assert k in mem[0], k
+
+
+def test_v5_stream_memory_event_stays_valid():
+    """FIELDS_SINCE_V6 compatibility: a pre-v6 memory/utilization event
+    without the residency/roofline fields validates under its own
+    vintage but NOT under v6 — old streams stay readable, new writers
+    cannot silently drop the fields."""
+    ev = {"event": "memory", "t": 0.0, "seq": 1, "phase": "init",
+          "devices": [], "host_rss_bytes": None}
+    assert validate_event(ev, version=5) == []
+    assert any("live_bytes" in p for p in validate_event(ev, version=6))
+    util = {"event": "utilization", "t": 0.0, "seq": 2, "round": 1,
+            "rounds": 1, "wall_s": 1.0, "flops_per_round": None,
+            "flops_source": None, "device_kind": "cpu",
+            "peak_flops": None, "achieved_flops": None, "mfu": None,
+            "input_wait_frac": 0.0, "dispatch_frac": 0.0,
+            "device_wait_frac": 0.0, "straggler_spread": None}
+    assert validate_event(util, version=5) == []
+    assert any("bound" in p for p in validate_event(util, version=6))
+
+
+# -------------------------------------------------- watcher integration
+
+
+def test_jitwatcher_emits_memory_ledger_into_stream(tmp_path):
+    rt = make_runtime()
+    tel = RunTelemetry(str(tmp_path), "test", cfg=rt.cfg)
+    tel.instrument(rt)
+    batch, mask, ids = make_batch()
+    rt.round(rt.init_state(), ids, batch, mask, 0.05)
+    w = tel.watcher()
+    tel.close()
+    assert validate_file(tel.path) == []
+    events = [json.loads(l) for l in open(tel.path)]
+    ml = [e for e in events if e["event"] == "memory_ledger"]
+    assert ml and ml[0]["name"] == "round_step"
+    assert ml[0]["temp_bytes"] and ml[0]["temp_bytes"] > 0
+    # the watcher keeps the latest ledger + cost-analysis bytes for the
+    # roofline join and the flight recorder's memory.json
+    assert "round_step" in w.memory
+    assert w.bytes.get("round_step", 0) > 0
+
+
+def test_memory_telemetry_is_hlo_invisible():
+    """Zero hot-path cost: the whole layer observes compiled artifacts
+    and allocator stats from the HOST — lowering the round after taking
+    a residency snapshot, a ledger, and under a pinned --peak_hbm_gbps
+    yields byte-identical HLO."""
+    batch, mask, ids = make_batch()
+    rt_a = make_runtime()
+    args_a = (rt_a.init_state(), ids, batch, mask,
+              jnp.asarray(0.05, jnp.float32), None)
+    hlo_a = rt_a._round.lower(*args_a).as_text()
+    rt_b = make_runtime(peak_hbm_gbps=819.0)
+    ResidencyTracker().snapshot(jax.devices())
+    round_memory_ledger(rt_b, rt_b.init_state(), ids, batch, mask)
+    args_b = (rt_b.init_state(), ids, batch, mask,
+              jnp.asarray(0.05, jnp.float32), None)
+    assert rt_b._round.lower(*args_b).as_text() == hlo_a
+
+
+# --------------------------------------------------------- hbm_pressure
+
+
+def test_hbm_pressure_rule_fires_on_peak_growth():
+    mon = AnomalyMonitor(None, window=16, min_points=8)
+    fired = []
+    for i in range(20):          # warm steady-state: ~8 GiB +- jitter
+        fired += mon.observe("memory", {
+            "phase": f"rounds_{i}",
+            "peak_bytes": 8e9 + (i % 3) * 1e6})
+    assert fired == []           # MiB-scale jitter is quiet
+    fired = mon.observe("memory", {"phase": "rounds_20",
+                                   "peak_bytes": 12e9})
+    assert [f["rule"] for f in fired] == ["hbm_pressure"]
+    assert fired[0]["severity"] == "warn"
+
+
+def test_hbm_pressure_quiet_on_null_peaks_cpu_stream():
+    mon = AnomalyMonitor(None, window=16, min_points=8)
+    fired = []
+    for i in range(30):          # the CPU container: every peak null
+        fired += mon.observe("memory", {"phase": f"rounds_{i}",
+                                        "peak_bytes": None})
+    assert fired == []
+
+
+# -------------------------------------------------- flight recorder
+
+
+def _tiny_state():
+    from commefficient_tpu.core.state import FedState
+    return FedState(ps_weights=jnp.arange(6, dtype=jnp.float32),
+                    Vvelocity=jnp.zeros(6), Verror=jnp.zeros(6),
+                    step=jnp.asarray(3, jnp.int32),
+                    rng=jnp.zeros(2, jnp.uint32))
+
+
+def test_flight_recorder_bundle_includes_memory_json(tmp_path):
+    """The satellite: the postmortem bundle ships the residency timeline
+    + the per-executable ledgers as memory.json (the separately
+    ring-buffered snapshots survive round/span traffic rotation)."""
+    rt = make_runtime()
+    tel = RunTelemetry(str(tmp_path), "test", cfg=rt.cfg)
+    tel.instrument(rt)
+    batch, mask, ids = make_batch()
+    rt.round(rt.init_state(), ids, batch, mask, 0.05)
+    tel.memory_event("rounds_1")
+    tel.memory_event("checkpoint_1")
+    rec = FlightRecorder(str(tmp_path), tel)
+    out = rec.record(_tiny_state(), {"rule": "hbm_pressure", "round": 1})
+    assert out is not None
+    mem = json.load(open(os.path.join(rec.path, "memory.json")))
+    assert [e["phase"] for e in mem["residency"]] == \
+        ["rounds_1", "checkpoint_1"]
+    assert "round_step" in mem["ledgers"]
+    assert mem["ledgers"]["round_step"]["temp_bytes"] > 0
+    tel.close()
+
+
+def test_recent_memory_ring_survives_round_traffic(tmp_path):
+    tel = RunTelemetry(str(tmp_path), "test", cfg=None)
+    tel.memory_event("init")
+    for i in range(300):         # rotate the MAIN ring completely
+        tel.event("round", round=i, epoch=1, lr=0.1, loss=2.0, acc=0.5,
+                  n_valid=4.0, download_bytes=None, upload_bytes=None,
+                  host_s=0.0, dispatch_s=0.0, device_s=0.0)
+    assert all(e["event"] != "memory" for e in tel.recent)
+    assert [e["phase"] for e in tel.recent_memory] == ["init"]
+    tel.close()
+
+
+# ------------------------------------------------------------- teleview
+
+
+def _teleview():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "teleview", os.path.join(os.path.dirname(__file__), os.pardir,
+                                 "scripts", "teleview.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_teleview_memory_literals_match_package():
+    """teleview runs jax-free off literal fallbacks of the key tuples —
+    pin them to the canonical values so they cannot drift."""
+    src = open(os.path.join(os.path.dirname(__file__), os.pardir,
+                            "scripts", "teleview.py")).read()
+    for name, canon in (("MEMORY_KEYS", MEMORY_KEYS),
+                        ("MEMORY_LEDGER_KEYS", MEMORY_LEDGER_KEYS),
+                        ("ROOFLINE_KEYS", ROOFLINE_KEYS)):
+        block = re.search(rf"\n    {name} = \((.*?)\)", src,
+                          re.S).group(1)
+        assert tuple(re.findall(r'"([a-z_0-9]+)"', block)) == canon, name
+
+
+def _write_mem_stream(path, temp_bytes=1000, bw_frac_bytes=5e8):
+    tel = RunTelemetry(str(path), "test", cfg=None)
+    tel.memory_ledger_event("round_step", {
+        "temp_bytes": temp_bytes, "argument_bytes": 200,
+        "output_bytes": 300, "alias_bytes": 50,
+        "generated_code_bytes": 7, "total_bytes": temp_bytes + 507})
+    tel.memory_event("init")
+    emit_from_totals(
+        tel, rnd=1, rounds=1, wall_s=1.0, host_s=0.1, dispatch_s=0.1,
+        device_s=0.5, flops_per_round=1e10, flops_source="analytic",
+        device_kind="fake", peak_flops=1e14,
+        bytes_per_round=bw_frac_bytes, bytes_source="cost_analysis",
+        peak_hbm_gbps=1.0)    # 1 GB/s peak: bw_frac = bytes / 1e9
+    tel.write_summary(aborted=False, n_rounds=1)
+    tel.close()
+    assert validate_file(tel.path) == []
+    return tel.path
+
+
+def test_teleview_memory_subcommand(tmp_path, capsys):
+    tv = _teleview()
+    p = _write_mem_stream(tmp_path / "a")
+    assert tv.main(["memory", p]) == 0
+    out = capsys.readouterr().out
+    assert "per-executable byte inventory" in out
+    assert "round_step" in out
+    assert "residency timeline" in out
+    assert "roofline" in out and "bandwidth" in out
+
+
+def test_teleview_diff_fails_on_temp_bytes_growth(tmp_path, capsys):
+    tv = _teleview()
+    a = _write_mem_stream(tmp_path / "a", temp_bytes=1000)
+    b = _write_mem_stream(tmp_path / "b", temp_bytes=2000)
+    assert tv.main(["diff", a, b]) == 1
+    assert "temp bytes" in capsys.readouterr().out
+    assert tv.main(["diff", a, b, "--temp_bytes_growth", "3.0"]) == 0
+
+
+def test_teleview_diff_fails_on_bw_frac_drop(tmp_path, capsys):
+    tv = _teleview()
+    a = _write_mem_stream(tmp_path / "a", bw_frac_bytes=5e8)   # 0.5
+    b = _write_mem_stream(tmp_path / "b", bw_frac_bytes=2e8)   # 0.2
+    assert tv.main(["diff", a, b]) == 1
+    assert "bw_frac" in capsys.readouterr().out
+    assert tv.main(["diff", a, b, "--bw_frac_drop", "0.5"]) == 0
+
+
+def test_teleview_timeline_hbm_counter_track(tmp_path):
+    tv = _teleview()
+    tel = RunTelemetry(str(tmp_path / "a"), "test", cfg=None)
+    # synthetic residency snapshot with live numbers (the CPU container
+    # reports none, so drive build_trace with a hand-built event)
+    tel.event("memory", phase="rounds_1", devices=[],
+              host_rss_bytes=None, live_bytes=2 * 2**30,
+              peak_bytes=3 * 2**30, delta_peak_bytes=None,
+              fragmentation_bytes=2**30, limit_bytes=16 * 2**30,
+              headroom_frac=0.8125)
+    tel.close()
+    trace = tv.build_trace([json.loads(l) for l in open(tel.path)])
+    counters = [e for e in trace["traceEvents"]
+                if e.get("ph") == "C"]
+    names = {e["name"] for e in counters}
+    assert "hbm_live_gib" in names and "hbm_peak_gib" in names
+    live = [e for e in counters if e["name"] == "hbm_live_gib"][0]
+    assert live["args"]["hbm_live_gib"] == pytest.approx(2.0)
