@@ -49,7 +49,9 @@ NOMINAL_SINGLE_GPU_TOK_PER_SEC = 4500.0
 
 def run(remat: bool = True, telemetry=None, profiler=None, *,
         remat_policy: str = "", microbatch: int = 8, lm_chunk: int = 128,
-        n_rounds: int = 8, compile_cache=None, dryrun: bool = False) -> dict:
+        fused_encode: str = "auto", decode_overlap: bool = False,
+        n_rounds: int = 8, compile_cache=None,
+        dryrun: bool = False) -> dict:
     """Build, warm up and time the GPT-2 round; returns the result dict.
 
     ``remat=True`` is the shipping configuration. remat=False spends the
@@ -64,6 +66,23 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
     granularity — the three knobs runs/BREAKDOWN_gpt2.md names between
     the measured 33% and the 40% target. ``microbatch`` must divide the
     dialogue client batch.
+
+    ``fused_encode`` passes through to --sketch_fused_encode: "auto"
+    (the shipping default — the microbatch scan carries the sketch
+    table and the dense (d,) gradient never materializes, ~0.5 GB of
+    temp at the flagship scale), "off" (the pre-fusion round — the
+    A/B arm whose ledger DOCUMENTS the dense materialization), or "on"
+    (fail fast if ineligible).
+
+    ``decode_overlap=True`` times the SPLIT round (--decode_overlap,
+    core/pipeline.DecodeOverlapRound: cohort + decode executables,
+    bit-identical losses) and records BOTH executables' memory ledgers
+    — the cohort ledger is where the fused encode's temp win is
+    measurable at all (in the monolithic round the server decode's own
+    dense (d,) buffers share temp slots with the client scan across
+    disjoint lifetimes, so the executable's PEAK barely moves), and
+    the decode running while the host stages round t+1 is ROADMAP
+    item 1's second half.
 
     ``dryrun=True`` shrinks the model (GPT2Config.small) and the round
     shape so every arm runs in seconds on the CPU container — the sweep
@@ -122,7 +141,9 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
                     num_workers=W, local_batch_size=B,
                     microbatch_size=microbatch,
                     num_clients=100, track_bytes=False, approx_topk=True,
-                    num_results_train=2, lm_chunk=lm_chunk, **sketch_kw)
+                    num_results_train=2, lm_chunk=lm_chunk,
+                    sketch_fused_encode=fused_encode,
+                    decode_overlap=decode_overlap, **sketch_kw)
     if compile_cache is not None:  # "" = disable (true cold start)
         cfg = cfg.replace(compilation_cache_dir=compile_cache)
     enable_compilation_cache(cfg)
@@ -137,7 +158,11 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
     mask = jnp.ones((W, B), bool)
     ids = jnp.arange(W, dtype=jnp.int32)
 
-    dt, metrics, phases = timed_rounds(runtime, (ids, batch, mask, 0.1),
+    bench_rt = runtime
+    if decode_overlap:
+        from commefficient_tpu.core import DecodeOverlapRound
+        bench_rt = DecodeOverlapRound(runtime)
+    dt, metrics, phases = timed_rounds(bench_rt, (ids, batch, mask, 0.1),
                                        warmup=1, rounds=n_rounds, desc="gpt2",
                                        profiler=profiler)
     warmup_s = phases.pop("warmup_s", None)
@@ -155,8 +180,9 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
     log(f"model FLOPs/round {flops:.3e}, peak {peak:.0f}, MFU {mfu:.3f}")
 
     # roofline attribution of the compiled round: cost-analysis bytes
-    # accessed + the memory_analysis ledger (temp bytes DOCUMENT the
-    # dense-gradient materialization the sketch round still pays — see
+    # accessed + the memory_analysis ledger (under the fused encode the
+    # dense (d,) gradient no longer appears in temp bytes; the
+    # fused_encode="off" A/B arm documents what it cost — see
     # telemetry/memory_ledger.py SKETCH_ENCODE_FUSED). With telemetry on
     # the JitWatcher already captured both at the warmup compile (and
     # instrument() replaced runtime._round with the watcher's closure,
@@ -165,27 +191,54 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
     # compile cache. NOTE the same scan caveat as flops: XLA's
     # bytes-accessed counts each scan body once, so the measured
     # arithmetic intensity is an UPPER bound for the scanned round.
-    nbytes = mledger = None
+    nbytes = mledger = decode_ledger = None
     if telemetry is not None:
         w = telemetry.watcher()
-        nbytes = w.bytes.get("round_step")
-        mledger = w.memory.get("round_step")
+        if decode_overlap:
+            # headline ledger = the CLIENT executable (where the fused
+            # encode's temp win lives); the server half rides alongside
+            parts = [w.bytes.get("cohort_step"), w.bytes.get("decode_step")]
+            nbytes = sum(p for p in parts if p) or None
+            mledger = w.memory.get("cohort_step")
+            decode_ledger = w.memory.get("decode_step")
+        else:
+            nbytes = w.bytes.get("round_step")
+            mledger = w.memory.get("round_step")
     else:
         def round_cost():
-            s0 = runtime.init_state()
-            compiled = runtime._round.lower(
-                s0, ids, batch, mask, jnp.asarray(0.1, jnp.float32),
-                runtime.cs).compile()
-            cost = compiled.cost_analysis()
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0] if cost else {}
             from commefficient_tpu.telemetry.memory_ledger import \
                 ledger_from_compiled
-            return cost.get("bytes accessed"), ledger_from_compiled(compiled)
+
+            def _cost(compiled):
+                cost = compiled.cost_analysis()
+                if isinstance(cost, (list, tuple)):
+                    cost = cost[0] if cost else {}
+                return (cost.get("bytes accessed"),
+                        ledger_from_compiled(compiled))
+
+            lr = jnp.asarray(0.1, jnp.float32)
+            if decode_overlap:
+                b1, l1 = _cost(runtime._cohort.lower(
+                    runtime.init_state(), ids, batch, mask, lr,
+                    runtime.cs).compile())
+                # shapes only — this path must stay compile-only (a
+                # real cohort execution is the dominant cost of a round)
+                s_shape, p_shape = jax.eval_shape(
+                    runtime._cohort, runtime.init_state(), ids, batch,
+                    mask, lr, runtime.cs)
+                b2, l2 = _cost(runtime._decode_jit.lower(
+                    s_shape, p_shape["sum"],
+                    jax.ShapeDtypeStruct((), jnp.float32),
+                    runtime._prep_lr(0.1), runtime.cs).compile())
+                return (((b1 or 0) + (b2 or 0)) or None, l1, l2)
+            compiled = runtime._round.lower(
+                runtime.init_state(), ids, batch, mask, lr,
+                runtime.cs).compile()
+            return _cost(compiled) + (None,)
 
         try:
-            nbytes, mledger = with_retries(round_cost,
-                                           desc="gpt2 round cost")
+            nbytes, mledger, decode_ledger = with_retries(
+                round_cost, desc="gpt2 round cost")
         except Exception as e:
             log(f"WARNING: round cost/memory analysis unavailable ({e})")
     from commefficient_tpu.telemetry.utilization import roofline_fields
@@ -213,10 +266,15 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
         "input_wait_frac": round(phases["host_s"] / dt, 6),
         "roofline": roof,
         "memory_ledger": mledger,
+        # present only under decode_overlap: the server half's ledger
+        # (the headline memory_ledger is then the COHORT executable)
+        "memory_ledger_decode": decode_ledger,
         "dryrun": dryrun,
         # the sweep knobs this arm ran under (scripts/gpt2_mfu_sweep.py)
         "config": {"remat": remat, "remat_policy": remat_policy,
-                   "microbatch": microbatch, "lm_chunk": lm_chunk},
+                   "microbatch": microbatch, "lm_chunk": lm_chunk,
+                   "fused_encode": fused_encode,
+                   "decode_overlap": decode_overlap},
     }
     if telemetry is not None:
         from commefficient_tpu.telemetry.utilization import emit_from_totals
@@ -230,6 +288,105 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
             bytes_source="cost_analysis")
         telemetry.bench_event(result["metric"], result)
     return result
+
+
+def ledger_ab(dryrun: bool = False) -> dict:
+    """Compile-only fused-vs-unfused A/B of the split round's COHORT
+    executable at a PARAMETER-DOMINATED GPT-2 geometry — the committed
+    proof the dense-gradient floor moved (runs/BREAKDOWN_gpt2.md
+    §Round 7).
+
+    The throughput sweep's smoke geometry (GPT2Config.small, 4x4x2x64)
+    cannot show the win: there d*4 is ~0.5 MB against ~10 MB of
+    activation working set, and backward-scheduling noise at that scale
+    is larger than the dense gradient itself. This A/B instead uses the
+    geometry class the fusion exists for — parameters >> activations
+    (the flagship 124M round is d*4 ~0.5 GB against ~tens of MB of
+    remat'd activations): ``dryrun=True`` runs a mid-size GPT-2
+    (d ~5.6M, one 32-token dialogue, microbatch 1) that compiles in
+    ~a minute on the CPU container; ``dryrun=False`` uses the flagship
+    config and round shape (TPU: the cohort compile is the same one the
+    bench pays, cache-shared). Nothing executes — the ledger reads
+    ``memory_analysis()`` off the compiled executables."""
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.core import FedRuntime
+    from commefficient_tpu.losses import make_gpt2_train_loss
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.telemetry.memory_ledger import \
+        ledger_from_compiled
+
+    if dryrun:
+        gcfg = GPT2Config(vocab_size=8192, n_positions=128, n_embd=256,
+                          n_layer=4, n_head=4, remat=True)
+        W, B, NC, S, mb = 1, 1, 1, 32, 1
+        sketch_kw = dict(k=5_000, num_rows=3, num_cols=262_144,
+                         num_blocks=8)
+    else:
+        gcfg = GPT2Config(remat=True)
+        W, B, NC, S, mb = 8, 8, 2, 256, 8
+        sketch_kw = dict(k=50_000, num_rows=5, num_cols=524_288,
+                         num_blocks=20)
+    model = GPT2DoubleHeads(gcfg)
+    rng = np.random.RandomState(0)
+    V = gcfg.vocab_size
+    batch = {
+        "input_ids": jnp.asarray(
+            rng.randint(0, V, (W, B, NC, S)), jnp.int32),
+        "mc_token_ids": jnp.asarray(rng.randint(0, S, (W, B, NC)),
+                                    jnp.int32),
+        "lm_labels": jnp.asarray(
+            rng.randint(0, V, (W, B, NC, S)), jnp.int32),
+        "mc_label": jnp.asarray(rng.randint(0, NC, (W, B)), jnp.int32),
+        "token_type_ids": jnp.asarray(
+            rng.randint(0, 2, (W, B, NC, S)), jnp.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"][0, :1],
+                        batch["mc_token_ids"][0, :1],
+                        batch["token_type_ids"][0, :1])
+    d = ravel_pytree(params)[0].shape[0]
+    mask = jnp.ones((W, B), bool)
+    ids = jnp.arange(W, dtype=jnp.int32)
+    rec = {"metric": "gpt2_fused_encode_ledger_ab", "d": int(d),
+           "dense_grad_bytes": int(d) * 4, "dryrun": dryrun,
+           "round_shape": [W, B, NC, S], "microbatch": mb,
+           "arms": {}}
+    for fe in ("auto", "off"):
+        cfg = FedConfig(mode="sketch", error_type="virtual",
+                        local_momentum=0.0, virtual_momentum=0.9,
+                        weight_decay=0.0, num_workers=W,
+                        local_batch_size=B, microbatch_size=mb,
+                        num_clients=100, track_bytes=False,
+                        approx_topk=True, num_results_train=2,
+                        lm_chunk=min(128, S), sketch_fused_encode=fe,
+                        decode_overlap=True, telemetry=False, **sketch_kw)
+        runtime = FedRuntime(
+            cfg, params, make_gpt2_train_loss(model, lm_chunk=cfg.lm_chunk),
+            num_clients=cfg.num_clients)
+
+        def compile_arm(runtime=runtime):
+            return runtime._cohort.lower(
+                runtime.init_state(), ids, batch, mask,
+                jnp.asarray(0.1, jnp.float32), runtime.cs).compile()
+
+        compiled = with_retries(compile_arm, desc=f"ledger_ab fe={fe}")
+        led = ledger_from_compiled(compiled)
+        rec["arms"][fe] = led
+        t = (led or {}).get("temp_bytes")
+        log(f"ledger_ab fe={fe}: cohort temp {t} "
+            f"({t / (d * 4):.2f}x d*4)" if t is not None else
+            f"ledger_ab fe={fe}: no ledger")
+    a, o = rec["arms"].get("auto") or {}, rec["arms"].get("off") or {}
+    if a.get("temp_bytes") is not None and o.get("temp_bytes") is not None:
+        rec["temp_drop_bytes"] = o["temp_bytes"] - a["temp_bytes"]
+        rec["drop_covers_dense_grad"] = bool(
+            rec["temp_drop_bytes"] >= d * 4)
+        log(f"ledger_ab: temp drop {rec['temp_drop_bytes']} B vs dense "
+            f"grad {d * 4} B -> covers: {rec['drop_covers_dense_grad']}")
+    return rec
 
 
 def main(argv=None):

@@ -471,6 +471,37 @@ class FedConfig:
     # fused-clients fast path (the clip is per-client); deferred encode
     # survives (clipped dense gradients still sum before one encode).
     sketch_dense_clip: bool = False
+    # Fused sketch encode (core/client.py): encode each per-microbatch
+    # gradient straight into the (r, c) Count Sketch table inside the
+    # microbatch scan — the scan carry is the table, so the dense (d,)
+    # gradient SUM never materializes in HBM (at GPT-2 124M the scan
+    # carry pair alone is ~1 GB of temp). Sound exactly when the encode
+    # deferral is sound AND nothing downstream consumes the dense
+    # per-client/aggregate gradient:
+    # - "auto" (default): engage when eligible, silently fall back to
+    #   the unfused path otherwise (numerics never change silently —
+    #   the fallback IS the old path);
+    # - "on": require it — fail fast with the blocking reason
+    #   (--sketch_dense_clip, DP clip+noise, --signals_exact's dense
+    #   shadow accumulator, the single-device signals dense capture,
+    #   a defense that clips dense per-client norms, the rht impl,
+    #   per-client grad stats on the vmap path);
+    # - "off": never (the pre-fusion round, bit-identical HLO).
+    # See README "Fused sketch encode" for the soundness matrix.
+    sketch_fused_encode: str = "auto"
+    # Split the federated round into two executables — the client block
+    # (cohort compute + table sum) and the server block (decode /
+    # top-k uncompress + weight update) — so the server decode of round
+    # t is dispatched as its own program and runs while the host (and
+    # the input pipeline) stage round t+1's client block, and a
+    # record-cadence metrics sync completes when the CLIENT half
+    # finishes instead of waiting out the decode. Losses are
+    # bit-identical to the monolithic round (dryrun-asserted; the split
+    # reuses the async cohort/commit machinery at K=1/M=1, which PR 6
+    # proved bitwise). Same soundness constraints as --async_agg (no
+    # per-client persistent rows, no topk_down) — unsound combos fail
+    # fast. Mutually exclusive with --async_agg (which already splits).
+    decode_overlap: bool = False
     # jointly-computed round gradient (core/client.py make_fused_grad):
     # when no per-client nonlinearity exists, accumulate the round's
     # aggregate into ONE (d,) buffer instead of vmap's per-client (W, d)
@@ -505,6 +536,20 @@ class FedConfig:
                 "--error_decay only applies to modes with virtual error " \
                 "(sketch, true_topk)"
         assert self.attn_impl in ("auto", "dense", "flash"), self.attn_impl
+        assert self.sketch_fused_encode in ("auto", "on", "off"), \
+            self.sketch_fused_encode
+        if self.sketch_fused_encode == "on" and self.mode != "sketch":
+            raise ValueError(
+                f"--sketch_fused_encode on requires --mode sketch (mode="
+                f"{self.mode} has no sketch encode to fuse); drop the flag "
+                "or use --sketch_fused_encode auto (a no-op off sketch "
+                "mode)")
+        if self.decode_overlap and self.async_agg:
+            raise ValueError(
+                "--decode_overlap and --async_agg are mutually exclusive: "
+                "async buffered aggregation already splits the round into "
+                "cohort and commit executables (and adds buffering "
+                "semantics on top). Drop one of the flags.")
         assert self.telemetry_every >= -1, self.telemetry_every
         assert self.alert_action in ALERT_ACTIONS, self.alert_action
         assert self.alert_window >= 4, self.alert_window
@@ -984,6 +1029,19 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                         "flash above (measured crossover)")
     p.add_argument("--no_fused_clients", dest="fused_clients",
                    action="store_false", default=True)
+    p.add_argument("--sketch_fused_encode", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="encode each per-microbatch gradient into the "
+                        "sketch table inside the microbatch scan (table "
+                        "carry; the dense (d,) gradient sum never hits "
+                        "HBM): auto = when sound, on = require (fail "
+                        "fast otherwise), off = the pre-fusion round")
+    p.add_argument("--decode_overlap", action="store_true",
+                   help="split the round into client and server-decode "
+                        "executables so the PS decode of round t runs "
+                        "while round t+1's client block is staged "
+                        "(bit-identical losses; same soundness "
+                        "constraints as --async_agg)")
     p.add_argument("--sketch_dense_clip", action="store_true",
                    help="clip the dense worker gradient before sketch "
                         "encode (threshold x num_iters) instead of the "

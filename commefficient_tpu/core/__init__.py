@@ -1,11 +1,14 @@
 from commefficient_tpu.core.server import server_update, validate_mode_combo
 from commefficient_tpu.core.state import FedState
 from commefficient_tpu.core.runtime import FedRuntime
-from commefficient_tpu.core.pipeline import RoundInput, RoundPipeline
+from commefficient_tpu.core.pipeline import (DecodeOverlapRound,
+                                             RoundInput, RoundPipeline)
 from commefficient_tpu.core.async_agg import (AsyncAggregator,
                                               staleness_weight,
-                                              validate_async_combo)
+                                              validate_async_combo,
+                                              validate_overlap_combo)
 
 __all__ = ["server_update", "validate_mode_combo", "FedState", "FedRuntime",
-           "RoundInput", "RoundPipeline", "AsyncAggregator",
-           "staleness_weight", "validate_async_combo"]
+           "RoundInput", "RoundPipeline", "DecodeOverlapRound",
+           "AsyncAggregator", "staleness_weight",
+           "validate_async_combo", "validate_overlap_combo"]

@@ -108,6 +108,39 @@ def staleness_weight(rule: str, staleness: float, alpha: float = 0.5
                      f"choices: {DISCOUNT_RULES}")
 
 
+def _split_round_problems(cfg: FedConfig) -> List[str]:
+    """Why a configuration cannot run its round as separate client/server
+    executables (the cohort step carries no per-client persistent-row or
+    topk_down plumbing — shared by --async_agg and --decode_overlap)."""
+    problems: List[str] = []
+    if cfg.needs_client_velocities:
+        problems.append(
+            "local_momentum > 0 keeps per-client velocity rows that the "
+            "synchronous round masks with the SAME round's server support "
+            "(momentum factor masking) — the split client block finishes "
+            "before that support exists, so the masking semantics cannot "
+            "be reproduced. Use local_momentum 0 (rely on "
+            "--virtual_momentum, which lives in server state and splits "
+            "soundly)")
+    if cfg.needs_client_errors:
+        problems.append(
+            "error_type=local keeps per-client error rows written at "
+            "dispatch; the split round's client block has no row "
+            "plumbing (and under buffering the rows would accumulate "
+            "against interleaved server versions the synchronous rule "
+            "never sees). Use error_type none (local_topk) or virtual "
+            "(sketch/true_topk — virtual EF lives in server state and "
+            "splits soundly)")
+    if cfg.do_topk_down:
+        problems.append(
+            "--topk_down keeps per-client stale weight vectors updated "
+            "at dispatch from the current server weights — the split "
+            "client block has no weight-row plumbing (and under "
+            "buffering a client's record diverges from what it actually "
+            "downloaded). Drop --topk_down")
+    return problems
+
+
 def validate_async_combo(cfg: FedConfig) -> None:
     """Reject mode combinations where buffered merge is unsound.
 
@@ -117,33 +150,25 @@ def validate_async_combo(cfg: FedConfig) -> None:
     contract of core/server.validate_mode_combo."""
     if not cfg.async_agg:
         return
-    problems: List[str] = []
-    if cfg.needs_client_velocities:
-        problems.append(
-            "local_momentum > 0 keeps per-client velocity rows that the "
-            "synchronous round masks with the SAME round's server support "
-            "(momentum factor masking) — a buffered commit's support "
-            "arrives after the cohort's rows were written, so the masking "
-            "semantics cannot be reproduced. Use local_momentum 0 (rely "
-            "on --virtual_momentum, which lives in commit-time server "
-            "state and buffers soundly)")
-    if cfg.needs_client_errors:
-        problems.append(
-            "error_type=local keeps per-client error rows written at "
-            "dispatch; with cohorts landing out of order the rows would "
-            "accumulate against interleaved server versions the "
-            "synchronous rule never sees. Use error_type none (local_topk) "
-            "or virtual (sketch/true_topk — virtual EF lives in server "
-            "state and buffers soundly)")
-    if cfg.do_topk_down:
-        problems.append(
-            "--topk_down keeps per-client stale weight vectors updated at "
-            "dispatch from the current server weights — under buffering a "
-            "client's record diverges from what it actually downloaded. "
-            "Drop --topk_down")
+    problems = _split_round_problems(cfg)
     if problems:
         raise ValueError(
             "--async_agg: buffered merge is unsound for this "
+            "configuration:\n  " + "\n  ".join(problems))
+
+
+def validate_overlap_combo(cfg: FedConfig) -> None:
+    """--decode_overlap's fail-fast twin of :func:`validate_async_combo`:
+    the split round shares the cohort step, so the same per-client
+    persistent-state combinations are out (config.py already rejects
+    --decode_overlap together with --async_agg)."""
+    if not cfg.decode_overlap:
+        return
+    problems = _split_round_problems(cfg)
+    if problems:
+        raise ValueError(
+            "--decode_overlap: splitting the round into client and "
+            "server-decode executables is unsound for this "
             "configuration:\n  " + "\n  ".join(problems))
 
 

@@ -127,6 +127,135 @@ def quarantine_zero(tx: jax.Array, n_valid: jax.Array,
     return tx, n_valid, results, fin
 
 
+# coalesce adjacent gradient leaves into at-least-this-many-element
+# chunks before the streaming encode: biases/layernorm leaves are tiny,
+# and one encode_accum per 768-element leaf would pay the per-range
+# block padding (and op count) hundreds of times per microbatch.
+# Measured best CPU-ledger packing at 1024 (the encode working set stays
+# a few blocks while the chunk count stays O(d / 1024)).
+_ENCODE_CHUNK_MIN = 1024
+# ... and split anything bigger than this into bounded ranges: one
+# encode_accum's working set is ~4 chunk-sized buffers (signs, signed
+# values, rolled, padding copy), so an uncapped 2M-element kernel leaf
+# would put ~32 MB of encode temporaries next to the cotangents the
+# fusion exists to shrink. Measured on the CPU ledger: capping at 64k
+# cut the fused client scan's temp ~30% with no measurable wall cost
+# (the cap only bounds PEAK residency; total encode work is unchanged).
+# The cap SCALES with the sketch's d (see _encode_chunk_max): a fixed
+# 64k cap at GPT-2 124M would unroll ~1900 encode_accum calls into the
+# scan body — a compile-time explosion — while d/32 keeps the chunk
+# count O(32) and the working set at ~d/8, far under the d*4 the
+# fusion removes.
+_ENCODE_CHUNK_MAX = 65536
+
+
+def _encode_chunk_max(d: int) -> int:
+    return max(_ENCODE_CHUNK_MAX, d // 32)
+
+
+def encode_grad_tree(cs, table, gtree, scale=None, token=None,
+                     min_chunk: int = _ENCODE_CHUNK_MIN,
+                     max_chunk: int = 0):
+    """Encode a gradient PYTREE into a carry sketch table, leaf range by
+    leaf range, without ever concatenating the (d,) dense vector.
+
+    The leaves are walked in ravel order (``jax.flatten_util``'s leaf
+    order — the layout every ``unravel`` consumer shares), adjacent
+    small leaves are coalesced into >= ``min_chunk``-element contiguous
+    chunks, oversized leaves are split into <= ``max_chunk`` ranges (the
+    encode working set stays bounded), and each chunk streams through
+    ``cs.encode_accum`` at its static global offset. Chunks are encoded
+    in REVERSE ravel order — the order the backward PRODUCES cotangents
+    (last layer first) — so the table-accumulation chain never forces an
+    early layer's not-yet-computed gradient ahead of a ready one, and
+    the scheduler may free each cotangent at its encode. (XLA's CPU
+    scheduler still keeps most of the tree resident — ~1.9x d*4 measured
+    against the theoretical interleave; a scan-structured model that
+    owns its backward gets all the way under d*4 via the
+    ``streaming_grad`` hook, models/stream_mlp.py.) Exception: when the
+    sketch's fused Pallas encode kernel is eligible (TPU, aligned
+    shifts — CirculantSketch._use_pallas_encode), the whole-vector route
+    is faster than per-chunk rolls, so the tree IS raveled once and
+    encoded in one kernel call — one (d,) buffer inside the scan step
+    instead of the unfused path's persistent (d,) carry pair.
+
+    Returns ``table + encode(scale * ravel(gtree))`` up to fp addition
+    order (sketch linearity; pinned by tests/test_fused_encode.py).
+    """
+    leaves = jax.tree_util.tree_leaves(gtree)
+    if getattr(cs, "_use_pallas_encode", lambda: False)():
+        flat = jnp.concatenate([l.reshape(-1) for l in leaves])
+        return cs.encode_accum(table, flat, 0, scale=scale, token=token)
+    if max_chunk <= 0:
+        max_chunk = _encode_chunk_max(int(getattr(cs, "d", 0)))
+    chunks = []          # (static start, [flat leaf pieces])
+    cur, cur_n, cur_start, off = [], 0, 0, 0
+    for leaf in leaves:
+        flat = leaf.reshape(-1)
+        n, pos = int(flat.size), 0
+        while n - pos > 0:
+            if not cur:
+                cur_start = off + pos
+            take = min(n - pos, max_chunk - cur_n)
+            cur.append(flat[pos:pos + take]
+                       if (pos or take < n) else flat)
+            cur_n += take
+            pos += take
+            if cur_n >= max_chunk:
+                chunks.append((cur_start, cur))
+                cur, cur_n = [], 0
+        off += n
+        if cur_n >= min_chunk:
+            chunks.append((cur_start, cur))
+            cur, cur_n = [], 0
+    if cur:
+        chunks.append((cur_start, cur))
+    for start, pieces in reversed(chunks):
+        vals = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+        table = cs.encode_accum(table, vals, start, scale=scale,
+                                token=token)
+    return table
+
+
+def fused_encode_blockers(cfg: FedConfig, signals: bool = False) -> list:
+    """Config-level blockers of the fused sketch encode
+    (``--sketch_fused_encode``), mirroring the fail-fast style of
+    ``validate_async_combo`` / ``validate_defense_combo``: every entry
+    names the dense-space consumer that makes accumulating in table
+    space unsound, and what to change. Returns the (possibly empty)
+    blocker list; ``FedRuntime`` merges in the topology/impl-dependent
+    blockers (dense-preimage server state, the rht transform, defenses
+    on the deferred-dense uploads, vmap-path grad stats) and raises
+    under ``--sketch_fused_encode on``. ``signals`` is whether the
+    per-round signal diagnostics are actually live (telemetry on, no
+    async/decode-overlap split) — ``--signals_exact`` only blocks then.
+    """
+    problems = []
+    if cfg.mode != "sketch":
+        problems.append(
+            f"--mode {cfg.mode} has no sketch encode to fuse")
+        return problems
+    if cfg.do_dp:
+        problems.append(
+            "--dp clips and noises the DENSE per-client gradient "
+            "(l2_norm_clip + worker noise) before the encode; fusing "
+            "would skip the privacy mechanism. Drop --dp, or run the "
+            "unfused round")
+    if cfg.sketch_dense_clip:
+        problems.append(
+            "--sketch_dense_clip clips the DENSE worker gradient before "
+            "the encode; the fused path never materializes it. Use the "
+            "table-Frobenius clip (--max_grad_norm without "
+            "--sketch_dense_clip), which stays available fused")
+    if cfg.signals_exact and signals:
+        problems.append(
+            "--signals_exact threads a dense shadow EF accumulator pair "
+            "(and the exact dense-error top-k) through the round — both "
+            "need the dense aggregated gradient the fusion removes. "
+            "Drop --signals_exact (or --no_signals)")
+    return problems
+
+
 def _num_microbatches(cfg: FedConfig, batch_size: int) -> Tuple[int, int]:
     if cfg.microbatch_size > 0:
         mb = min(batch_size, cfg.microbatch_size)
@@ -142,6 +271,7 @@ def make_forward_grad(
     batch_size: int,
     defer_encode: bool = False,
     with_stats: bool = False,
+    fused_encode: bool = False,
 ):
     """Build the microbatched forward/backward (reference fed_worker.py:249-335).
 
@@ -159,15 +289,49 @@ def make_forward_grad(
     (``grad_norm_post``), and whether the applicable clip actually bound
     (``clip_frac``, NaN when no clip applies). ``stats`` is None when
     disabled, so the extra reductions are compiled out.
+
+    ``fused_encode`` (sketch mode only; FedRuntime gates soundness):
+    the microbatch scan carries the (r, c) Count Sketch TABLE instead of
+    the (d,) dense gradient sum — each microbatch's gradient is taken
+    against the parameter PYTREE (no ravel concat) and streamed into the
+    carry via ``encode_grad_tree`` (sum-of-sketches == sketch-of-sum,
+    the FetchSGD linearity), so a per-microbatch gradient lives only
+    inside one scan step and the returned ``g`` IS the client's table.
+    The weight-decay term encodes separately by the same linearity.
+    Escape hatch for scan-structured models: a ``loss_fn`` carrying a
+    ``streaming_grad`` attribute — ``streaming_grad(params_vec,
+    mb_batch, mb_mask, cs, table, scale=None) -> (table, loss,
+    metrics)`` — owns its own backward and streams per-LAYER gradients
+    into the table (no whole-model gradient pytree at all; contract
+    pinned by tests/test_fused_encode.py). Requires no dense-space
+    consumer (dense clip/DP/stats) — the runtime validates; asserted
+    here. The table-Frobenius clip stays available (per-table op).
     """
     num_iters, mb = _num_microbatches(cfg, batch_size)
     pad_to = num_iters * mb
+    if fused_encode:
+        # max_grad_norm WITHOUT --sketch_dense_clip is the table-
+        # Frobenius clip — a per-table op the fused path applies to its
+        # own carry below, so it stays available (as today)
+        assert cfg.mode == "sketch" and not with_stats \
+            and not cfg.do_dp and not cfg.sketch_dense_clip, \
+            "fused_encode eligibility is the runtime's job (see " \
+            "FedRuntime); an ineligible combination reached the client"
 
     def loss_on_vec(vec, mb_batch, mb_mask):
         loss, metrics = loss_fn(unravel(vec), mb_batch, mb_mask)
         return loss, metrics
 
     grad_fn = jax.value_and_grad(loss_on_vec, has_aux=True)
+    # fused-encode: differentiate w.r.t. the PYTREE. Mathematically the
+    # same leaf cotangents (unravel is slice+reshape; its VJP is the
+    # concatenation we are eliminating) — but the concat never happens,
+    # and neither does its in-scan transpose (one pad-to-(d,)-and-add
+    # per leaf, measured 131x d·4 temp on the CPU backend).
+    tree_grad_fn = (jax.value_and_grad(loss_fn, has_aux=True)
+                    if fused_encode else None)
+    stream = (getattr(loss_fn, "streaming_grad", None)
+              if fused_encode else None)
 
     def fwd(params_vec, batch, mask, rng, cs=None):
         # ``cs`` is threaded as a CALL-TIME argument (not a closure): its
@@ -185,20 +349,40 @@ def make_forward_grad(
             lambda t: t.reshape((num_iters, mb) + t.shape[1:]), batch)
         micro_masks = mask.reshape(num_iters, mb)
 
+        params = unravel(params_vec) if fused_encode else None
+
         def body(carry, inp):
             g_acc, loss_acc, metrics_acc = carry
             mb_batch, mb_mask = inp
-            (loss, metrics), g = grad_fn(params_vec, mb_batch, mb_mask)
+            if fused_encode:
+                # g_acc is the (r, c) table: the per-microbatch gradient
+                # exists only inside this step (as leaf cotangents, or
+                # not at all on the streaming path)
+                if stream is not None:
+                    g_acc, loss, metrics = stream(params_vec, mb_batch,
+                                                  mb_mask, cs, g_acc)
+                else:
+                    (loss, metrics), gtree = tree_grad_fn(
+                        params, mb_batch, mb_mask)
+                    g_acc = encode_grad_tree(cs, g_acc, gtree, token=loss)
+            else:
+                (loss, metrics), g = grad_fn(params_vec, mb_batch, mb_mask)
+                g_acc = g_acc + g
             w = mb_mask.sum()
             metrics_acc = jax.tree.map(
                 lambda a, m: a + m * w, metrics_acc, tuple(metrics))
-            return (g_acc + g, loss_acc + loss * w, metrics_acc), None
+            return (g_acc, loss_acc + loss * w, metrics_acc), None
 
         # probe metrics structure without running the model twice: metrics
         # accumulators start at zero scalars shaped like the loss outputs
         metrics_zero = tuple(
             jnp.zeros(()) for _ in range(cfg.num_results_train - 1))
-        init = (jnp.zeros_like(params_vec), jnp.zeros(()), metrics_zero)
+        if fused_encode:
+            assert cs is not None, "fused encode requires the runtime's sketch"
+            g_init = cs.empty_table()
+        else:
+            g_init = jnp.zeros_like(params_vec)
+        init = (g_init, jnp.zeros(()), metrics_zero)
         (g, loss_sum, metrics_sum), _ = lax.scan(
             body, init, (micro_batches, micro_masks))
 
@@ -210,8 +394,17 @@ def make_forward_grad(
         # decoupled weight decay (reference utils.py:254-259). Seq-sharded
         # rounds sum per-shard terms then divide by the shard count in the
         # runtime's aggregation, so no per-shard correction is needed here.
+        # Fused-encode: the wd term is linear too, so it encodes straight
+        # into the table (whole-vector range — the Pallas route when
+        # eligible) instead of forcing a dense g back into existence.
         if cfg.weight_decay != 0:
-            g = g + (cfg.weight_decay / cfg.num_workers) * params_vec
+            if fused_encode:
+                g = cs.encode_accum(
+                    g, params_vec, 0,
+                    scale=cfg.weight_decay / cfg.num_workers,
+                    token=loss_sum)
+            else:
+                g = g + (cfg.weight_decay / cfg.num_workers) * params_vec
         stats = None
         if with_stats:
             # telemetry/clients.py: the clip threshold this client's
@@ -263,7 +456,13 @@ def make_forward_grad(
         # (sum-of-sketches == sketch-of-sum) to encode ONCE after the
         # cross-client sum instead of once per client — legal whenever no
         # per-client nonlinearity acts on the table (no table clip).
-        if cfg.mode == "sketch" and not defer_encode:
+        # Fused-encode: ``g`` already IS this client's table, so only
+        # the per-table ops (the Frobenius clip) remain.
+        if cfg.mode == "sketch" and fused_encode:
+            if cfg.max_grad_norm is not None and not cfg.sketch_dense_clip:
+                # reference semantics: clip the TABLE (fed_worker.py:318)
+                g = cs.clip(g, cfg.max_grad_norm)
+        elif cfg.mode == "sketch" and not defer_encode:
             assert cs is not None, "sketch mode requires the runtime's sketch"
             table = cs.encode(g)
             if cfg.max_grad_norm is not None and not cfg.sketch_dense_clip:
@@ -280,6 +479,7 @@ def make_fused_grad(
     loss_fn: Callable,
     unravel: Callable[[jax.Array], Any],
     batch_size: int,
+    fused_encode: bool = False,
 ):
     """Jointly-computed round gradient: one microbatch scan over ALL of the
     round's clients instead of ``vmap(per-client scan)``.
@@ -297,6 +497,14 @@ def make_fused_grad(
     ~67 ms/round of the flagship GPT-2 round in exactly those per-client
     wte-gradient buffers (runs/profile_gpt2/BREAKDOWN.md).
 
+    ``fused_encode`` (sketch mode; FedRuntime gates soundness) goes one
+    step further down the same linearity: the scan carry is the (r, c)
+    Count Sketch TABLE, each microbatch's gradient pytree streams into
+    it via ``encode_grad_tree`` scaled by its client's datum count, and
+    the round's ONE (d,) accumulator disappears too — the returned ``g``
+    is the round's summed table (sketch-of-weighted-sum). The runtime's
+    deferred encode-once then becomes a no-op (the degenerate case).
+
     Exactness relies on microbatches never straddling clients: requires
     ``batch_size % microbatch == 0`` (checked by the runtime's
     eligibility predicate). Per-client results/n_valid keep their (W,)
@@ -304,13 +512,23 @@ def make_fused_grad(
     """
     num_iters, mb = _num_microbatches(cfg, batch_size)
     assert num_iters * mb == batch_size, (num_iters, mb, batch_size)
+    if fused_encode:
+        assert cfg.mode == "sketch" and not cfg.do_dp \
+            and not cfg.sketch_dense_clip and cfg.max_grad_norm is None, \
+            "fused_encode eligibility is the runtime's job (see FedRuntime)"
 
     def loss_on_vec(vec, mb_batch, mb_mask):
         return loss_fn(unravel(vec), mb_batch, mb_mask)
 
     grad_fn = jax.value_and_grad(loss_on_vec, has_aux=True)
+    # fused-encode: differentiate w.r.t. the PYTREE (see make_forward_grad
+    # — same cotangents, no concat and no in-scan pad-to-(d,) transpose)
+    tree_grad_fn = (jax.value_and_grad(loss_fn, has_aux=True)
+                    if fused_encode else None)
+    stream = (getattr(loss_fn, "streaming_grad", None)
+              if fused_encode else None)
 
-    def fused(params_vec, batch, mask):
+    def fused(params_vec, batch, mask, cs=None):
         W = mask.shape[0]
         maskf = mask.astype(jnp.float32)
         n_per_client = maskf.sum(axis=1)                     # (W,)
@@ -322,24 +540,51 @@ def make_fused_grad(
         client_of_mb = jnp.repeat(jnp.arange(W), num_iters)
         nc_of_mb = jnp.repeat(n_per_client, num_iters)
 
+        params = unravel(params_vec) if fused_encode else None
+
         def body(carry, inp):
             g_acc, sums = carry
             mb_batch, mb_mask, c, nc = inp
-            (loss, metrics), g = grad_fn(params_vec, mb_batch, mb_mask)
+            if fused_encode:
+                # g_acc is the round's (r, c) table: the microbatch
+                # gradient exists only inside this step, scaled by its
+                # client's datum count on the way in (linearity)
+                if stream is not None:
+                    g_acc, loss, metrics = stream(params_vec, mb_batch,
+                                                  mb_mask, cs, g_acc,
+                                                  scale=nc)
+                else:
+                    (loss, metrics), gtree = tree_grad_fn(
+                        params, mb_batch, mb_mask)
+                    g_acc = encode_grad_tree(cs, g_acc, gtree, scale=nc,
+                                             token=loss)
+            else:
+                (loss, metrics), g = grad_fn(params_vec, mb_batch, mb_mask)
+                g_acc = g_acc + g * nc
             w = mb_mask.sum()
-            g_acc = g_acc + g * nc
             sums = sums.at[:, c].add(
                 jnp.stack((loss,) + tuple(metrics)) * w)
             return (g_acc, sums), None
 
-        init = (jnp.zeros_like(params_vec), jnp.zeros((n_res, W)))
+        if fused_encode:
+            assert cs is not None, "fused encode requires the runtime's sketch"
+            g_init = cs.empty_table()
+        else:
+            g_init = jnp.zeros_like(params_vec)
+        init = (g_init, jnp.zeros((n_res, W)))
         (g, sums), _ = lax.scan(
             body, init, (flat, flat_mask, client_of_mb, nc_of_mb))
         # decoupled weight decay, summed over the round's clients (equal to
-        # the per-client term (wd/W)*w scaled by n_c and summed)
+        # the per-client term (wd/W)*w scaled by n_c and summed); fused-
+        # encode streams it into the table by the same linearity
         if cfg.weight_decay != 0:
-            g = g + ((cfg.weight_decay / cfg.num_workers)
-                     * n_per_client.sum()) * params_vec
+            wd_scale = ((cfg.weight_decay / cfg.num_workers)
+                        * n_per_client.sum())
+            if fused_encode:
+                g = cs.encode_accum(g, params_vec, 0, scale=wd_scale,
+                                    token=sums[0].sum())
+            else:
+                g = g + wd_scale * params_vec
         denom = jnp.maximum(n_per_client, 1.0)
         results = tuple(sums[j] / denom for j in range(n_res))
         return g, results, n_per_client
@@ -354,6 +599,7 @@ def make_client_step(
     batch_size: int,
     defer_encode: bool = False,
     with_stats: bool = False,
+    fused_encode: bool = False,
 ):
     """Single-round client step: forward_grad + local momentum / error /
     local-topk pipeline (reference fed_worker.py:184-230).
@@ -363,13 +609,19 @@ def make_client_step(
     ``velocity``/``error`` are this client's persistent rows (or None when the
     mode doesn't allocate them, reference fed_aggregator.py:105-129).
 
+    ``fused_encode`` (sketch mode — which forbids local momentum/error
+    rows, so the post-fwd pipeline below is shape-agnostic): ``g`` comes
+    back as this client's (r, c) table and the datum-count weighting /
+    quarantine / injection all act on it by sketch linearity.
+
     Seq-sharded rounds (runtime seq axis): the loss closure itself carries
     the seq semantics (losses.make_gpt2_train_loss seq_axis); this step is
     per-shard linear and the runtime handles the cross-shard sum/scale.
     """
     fwd = make_forward_grad(cfg, loss_fn, unravel, batch_size,
                             defer_encode=defer_encode,
-                            with_stats=with_stats)
+                            with_stats=with_stats,
+                            fused_encode=fused_encode)
 
     def step(params_vec, batch, mask, velocity, error, rng,
              cs=None) -> ClientOut:

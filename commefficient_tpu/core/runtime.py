@@ -282,7 +282,8 @@ class FedRuntime:
         # against the SAME round's server update, which async decouples),
         # so signals are off there — loudly, not silently: the
         # async_round event's EF norms are the async health channel.
-        self._signals = cfg.signals and cfg.telemetry and not cfg.async_agg
+        self._signals = (cfg.signals and cfg.telemetry
+                         and not cfg.async_agg and not cfg.decode_overlap)
         if cfg.signals and cfg.telemetry and cfg.async_agg:
             import sys
             print("NOTE: --async_agg disables the per-round `signals` "
@@ -290,6 +291,13 @@ class FedRuntime:
                   "the same round's server update, which buffered "
                   "aggregation decouples); commit-granularity EF norms "
                   "are emitted on the `async_round` events instead. Pass "
+                  "--no_signals to silence this note.", file=sys.stderr)
+        if cfg.signals and cfg.telemetry and cfg.decode_overlap:
+            import sys
+            print("NOTE: --decode_overlap disables the per-round `signals` "
+                  "diagnostics: the split round's client block finishes "
+                  "before the server decode it would be compared against "
+                  "(that early finish is the point of the split). Pass "
                   "--no_signals to silence this note.", file=sys.stderr)
         # the dense pre-encode aggregate exists only where the deferred
         # encode runs once on one device — capture it there so sketch
@@ -359,19 +367,73 @@ class FedRuntime:
         # client stat would be fabricated data.
         self._client_grad_stats = (self._client_stats and not self._fused
                                    and self._seq_axis is None)
+        # ---- fused sketch encode (ROADMAP item 1; core/client.py
+        # make_forward_grad / make_fused_grad): the microbatch scan
+        # carries the (r, c) sketch TABLE instead of the dense (d,)
+        # gradient sum, so the dense gradient never materializes in HBM
+        # (telemetry/memory_ledger.py SKETCH_ENCODE_FUSED is the
+        # committed acceptance gate). Eligibility is decided ONCE here
+        # from config + topology: "auto" silently falls back to the
+        # unfused round (the fallback IS the pre-fusion path — numerics
+        # never change silently), "on" fails fast listing every blocker.
+        fe_problems = client_lib.fused_encode_blockers(
+            cfg, signals=self._signals)
+        if cfg.mode == "sketch":
+            if self._dense_preimage:
+                fe_problems.append(
+                    "the dense-preimage server state (sketch_impl=rht / "
+                    "--sketch_server_state dense) consumes the dense "
+                    "aggregated gradient — there is no table to "
+                    "accumulate into")
+            elif (getattr(self.cs, "dense_transform", False)
+                    or not hasattr(self.cs, "encode_accum")):
+                fe_problems.append(
+                    f"sketch_impl={cfg.sketch_impl} has a dense transform "
+                    "(no streaming range encode); use circ or hash")
+            if cfg.defense != "none" and self._defer_encode:
+                fe_problems.append(
+                    f"--defense {cfg.defense} measures per-client norms on "
+                    "the dense deferred-encode uploads; fusing would move "
+                    "the defense to table-Frobenius space and silently "
+                    "change its clipping/trimming numerics")
+            if self._client_grad_stats:
+                fe_problems.append(
+                    "per-client grad-norm stats (telemetry/clients.py) "
+                    "measure dense gradient norms on the vmap path; pass "
+                    "--no_client_stats (or --no_telemetry)")
+        self._fused_encode = (cfg.mode == "sketch"
+                              and cfg.sketch_fused_encode != "off"
+                              and not fe_problems)
+        if cfg.sketch_fused_encode == "on" and not self._fused_encode:
+            raise ValueError(
+                "--sketch_fused_encode on: the fused sketch encode is "
+                "unsound for this configuration (use auto to fall back "
+                "to the unfused round instead):\n  "
+                + "\n  ".join(fe_problems))
+        if self._fused_encode and self._signals_dense_cap:
+            import sys
+            print("NOTE: the fused sketch encode removes the dense "
+                  "aggregated gradient the sketch-mode signals capture "
+                  "(grad_true_norm and the collision-noise reference go "
+                  "null). Pass --sketch_fused_encode off to keep them at "
+                  "the cost of the dense (d,) materialization.",
+                  file=sys.stderr)
+            self._signals_dense_cap = False
         if cfg.mode == "fedavg":
             self._client_fn = client_lib.make_fedavg_client(
                 cfg, loss_fn_train, unravel, self.batch_size,
                 with_stats=self._client_grad_stats)
         elif self._fused:
             self._fused_fn = client_lib.make_fused_grad(
-                cfg, loss_fn_train, unravel, self.batch_size)
+                cfg, loss_fn_train, unravel, self.batch_size,
+                fused_encode=self._fused_encode)
             self._client_fn = None
         else:
             self._client_fn = client_lib.make_client_step(
                 cfg, loss_fn_train, unravel, self.batch_size,
                 defer_encode=self._defer_encode,
-                with_stats=self._client_grad_stats)
+                with_stats=self._client_grad_stats,
+                fused_encode=self._fused_encode)
         self._val_fn_inner = client_lib.make_val_step(cfg, loss_fn_val, unravel)
 
         if self.shardings is not None:
@@ -406,10 +468,20 @@ class FedRuntime:
         # into a client-compute cohort step (dispatch time) and a server
         # commit step (buffer-goal time), plus a trivial merge. Built only
         # under --async_agg — the synchronous path compiles nothing new.
+        # --decode_overlap reuses the SAME cohort step (the client half)
+        # plus a buffer-free decode step (core/pipeline.DecodeOverlapRound
+        # drives them): the server decode of round t runs as its own
+        # executable, so a metrics sync completes when the client half
+        # finishes and the host stages round t+1 under the decode.
         self._cohort = self._commit_jit = self._merge_jit = None
-        if cfg.async_agg:
-            from commefficient_tpu.core.async_agg import validate_async_combo
-            validate_async_combo(cfg)
+        self._decode_jit = None
+        if cfg.async_agg or cfg.decode_overlap:
+            from commefficient_tpu.core.async_agg import (
+                validate_async_combo, validate_overlap_combo)
+            if cfg.async_agg:
+                validate_async_combo(cfg)
+            else:
+                validate_overlap_combo(cfg)
             if self.shardings is not None:
                 sh = self.shardings
                 cs_sh = jax.tree.map(lambda _: sh.replicated, self.cs)
@@ -419,21 +491,33 @@ class FedRuntime:
                                   self.batch_sharding(), sh.round_axis,
                                   None, cs_sh),
                     out_shardings=(self._state_sharding, None))
-                self._commit_jit = jax.jit(
-                    self._commit_step, donate_argnums=(0,),
-                    in_shardings=(self._state_sharding, None, cs_sh),
-                    out_shardings=(self._state_sharding, None))
-                self._merge_jit = jax.jit(
-                    self._merge_step, donate_argnums=(0,),
-                    in_shardings=(self._state_sharding, None, None, None),
-                    out_shardings=self._state_sharding)
+                if cfg.async_agg:
+                    self._commit_jit = jax.jit(
+                        self._commit_step, donate_argnums=(0,),
+                        in_shardings=(self._state_sharding, None, cs_sh),
+                        out_shardings=(self._state_sharding, None))
+                    self._merge_jit = jax.jit(
+                        self._merge_step, donate_argnums=(0,),
+                        in_shardings=(self._state_sharding, None, None,
+                                      None),
+                        out_shardings=self._state_sharding)
+                else:
+                    self._decode_jit = jax.jit(
+                        self._decode_step, donate_argnums=(0,),
+                        in_shardings=(self._state_sharding, None, None,
+                                      None, cs_sh),
+                        out_shardings=self._state_sharding)
             else:
                 self._cohort = jax.jit(self._cohort_step,
                                        donate_argnums=(0,))
-                self._commit_jit = jax.jit(self._commit_step,
-                                           donate_argnums=(0,))
-                self._merge_jit = jax.jit(self._merge_step,
-                                          donate_argnums=(0,))
+                if cfg.async_agg:
+                    self._commit_jit = jax.jit(self._commit_step,
+                                               donate_argnums=(0,))
+                    self._merge_jit = jax.jit(self._merge_step,
+                                              donate_argnums=(0,))
+                else:
+                    self._decode_jit = jax.jit(self._decode_step,
+                                               donate_argnums=(0,))
 
     def set_compile_watcher(self, watcher) -> None:
         """Compile observability hook (telemetry.JitWatcher): wraps the
@@ -450,7 +534,10 @@ class FedRuntime:
         self._val = watcher.wrap("val_step", self._val)
         if self._cohort is not None:
             self._cohort = watcher.wrap("cohort_step", self._cohort)
+        if self._commit_jit is not None:
             self._commit_jit = watcher.wrap("commit_step", self._commit_jit)
+        if self._decode_jit is not None:
+            self._decode_jit = watcher.wrap("decode_step", self._decode_jit)
 
     def _probe_seq_grad_scale(self) -> float:
         """Measure how the round's cross-seq-shard gradient sum over-counts
@@ -639,6 +726,44 @@ class FedRuntime:
             if client_finite is not None else nan)
         return d
 
+    def _download_coord_counts(self, coord_last_update: jax.Array,
+                               thresholds: jax.Array) -> jax.Array:
+        """Per-client count of coordinates updated at-or-after the
+        client's last download (the download-byte accounting): counts[w]
+        = |{i : coord_last_update[i] >= thresholds[w]}|.
+
+        Single device this streams BLOCK by block through a lax.scan —
+        the obvious fused broadcast-compare-reduce materializes its
+        converted (W, d) s32 intermediate on CPU and TPU (measured: the
+        largest temp buffer of the fused-encode cohort, 2x the dense
+        gradient this PR's encode fusion removes; ~4 GB at GPT-2 124M
+        with 8 clients), so the accounting would single-handedly fail
+        the dryrun's temp < d*4 gate. Peak temp here is O(W * block).
+        On a mesh the broadcast form stays: the d axis is sharded, so
+        each device holds only a (W, d/n) slice, and a host-chosen block
+        split would fight the partitioner's own sharding of d."""
+        if self._axis is not None:
+            return (coord_last_update[None, :]
+                    >= thresholds[:, None]).sum(axis=1)
+        d = coord_last_update.shape[0]
+        blk = max(512, min(65536, d // 16))
+        nb = -(-d // blk)
+        pad = nb * blk - d
+        if pad:
+            # padding must never satisfy ``>= threshold`` for any real
+            # threshold (round indices) — int32 min is below them all
+            coord_last_update = jnp.pad(
+                coord_last_update, (0, pad),
+                constant_values=jnp.iinfo(jnp.int32).min)
+        blocks = coord_last_update.reshape(nb, blk)
+
+        def body(acc, b):
+            return acc + (b[None, :] >= thresholds[:, None]).sum(axis=1), None
+
+        counts, _ = lax.scan(
+            body, jnp.zeros(thresholds.shape, jnp.int32), blocks)
+        return counts
+
     # ------------------------------------------------------------- round step
 
     def _round_step(self, state: FedState, client_ids: jax.Array,
@@ -655,10 +780,8 @@ class FedRuntime:
         client_last_round = state.client_last_round
         if cfg.track_bytes:
             thresholds = state.client_last_round[client_ids]
-            # one fused broadcast-compare-reduce over (W, d) — a lax.map here
-            # would run W serialized full-d passes
-            counts = (state.coord_last_update[None, :]
-                      >= thresholds[:, None]).sum(axis=1)
+            counts = self._download_coord_counts(state.coord_last_update,
+                                                 thresholds)
             # per-SLOT byte vectors kept alive for the client_stats
             # quantiles (telemetry/clients.py) — the scatter below is the
             # same data keyed by client id over the whole universe
@@ -767,8 +890,10 @@ class FedRuntime:
                 # (d,) accumulator over all local clients' microbatches —
                 # no per-client (W, d) gradient materialization (the
                 # robustness flags that need per-client uploads force
-                # the vmap path, see __init__)
-                agg, f_results, f_nvalid = self._fused_fn(used, batch, mask)
+                # the vmap path, see __init__). Under the fused sketch
+                # encode the accumulator is the (r, c) table itself.
+                agg, f_results, f_nvalid = self._fused_fn(used, batch,
+                                                          mask, cs)
                 out = client_lib.ClientOut(None, None, None, f_results,
                                            f_nvalid)
             else:
@@ -790,7 +915,12 @@ class FedRuntime:
             if t_agg is not None:
                 agg = t_agg
             sig_dense = None
-            if self._defer_encode and not self._dense_preimage:
+            if (self._defer_encode and not self._dense_preimage
+                    and not self._fused_encode):
+                # fused-encode: the clients already accumulated in table
+                # space, so the deferred encode-once is a no-op (its
+                # degenerate case) and no dense aggregate exists to
+                # capture (_signals_dense_cap was cleared in __init__)
                 if self._signals_dense_cap:
                     # keep the dense summed gradient alive for the signal
                     # norms/shadow (single device only — the buffer
@@ -1179,8 +1309,8 @@ class FedRuntime:
         client_last_round = state.client_last_round
         if cfg.track_bytes:
             thresholds = state.client_last_round[client_ids]
-            counts = (state.coord_last_update[None, :]
-                      >= thresholds[:, None]).sum(axis=1)
+            counts = self._download_coord_counts(state.coord_last_update,
+                                                 thresholds)
             down_slot = 4.0 * counts.astype(jnp.float32)
             up_slot = jnp.full((num_workers,), 4.0 * cfg.upload_floats,
                                jnp.float32)
@@ -1215,7 +1345,8 @@ class FedRuntime:
                         used, batch, mask, lr_c, client_rngs)
                 tx = out.transmit
             elif self._fused:
-                agg, f_results, f_nvalid = self._fused_fn(used, batch, mask)
+                agg, f_results, f_nvalid = self._fused_fn(used, batch,
+                                                          mask, cs)
                 out = client_lib.ClientOut(None, None, None, f_results,
                                            f_nvalid)
             else:
@@ -1233,7 +1364,8 @@ class FedRuntime:
                     tx, out, adv, ref, client_rngs)
             if t_agg is not None:
                 agg = t_agg
-            if self._defer_encode and not self._dense_preimage:
+            if (self._defer_encode and not self._dense_preimage
+                    and not self._fused_encode):
                 agg = cs.encode(agg)
             if wire and self._axis is None and agg.ndim == 2:
                 agg = agg.astype(td).astype(jnp.float32)
@@ -1364,16 +1496,17 @@ class FedRuntime:
             async_buffer=state.async_buffer + weight * cohort_sum,
             async_buffer_n=state.async_buffer_n + n_total)
 
-    def _commit_step(self, state: FedState, lr: jax.Array, cs=None):
-        """Server half of the round: normalize the buffered aggregate,
-        run the mode's momentum+EF update (core/server.py — identical
-        code to the sync round), apply it to the weights, and reset the
-        buffer. ``step`` advances here: it is the server version."""
+    def _server_tail_fields(self, state: FedState, agg: jax.Array,
+                            lr: jax.Array, server_rng: jax.Array, cs=None):
+        """The split round's shared server tail (normalize happened at
+        the caller): the mode's momentum+EF ``server_update``, the
+        weight apply, and the byte/nan bookkeeping — ONE implementation
+        consumed by both the async commit and the decode-overlap decode
+        (the ``_transmit_tail`` lesson applied to the server half: the
+        bit-identity contracts ride on these paths never drifting
+        apart). Returns ``(replace_fields, update, Vvel, Verr)``; the
+        caller owns ``rng`` advancement and any buffer handling."""
         cfg = self.cfg
-        rng, server_rng = jax.random.split(state.rng)
-        total = jnp.maximum(state.async_buffer_n, 1.0)
-        agg = state.async_buffer / total
-
         server_lr = jnp.asarray(1.0) if cfg.mode == "fedavg" else lr
         if (cfg.mode == "sketch" and not self._dense_preimage
                 and server_lr.ndim == 1):
@@ -1399,18 +1532,31 @@ class FedRuntime:
         bad = ~jnp.isfinite(update).all() | ~jnp.isfinite(agg).all()
         nan_round = jnp.where((state.nan_round < 0) & bad, state.step,
                               state.nan_round)
-
-        new_state = state.replace(
+        fields = dict(
             ps_weights=ps_weights,
             Vvelocity=Vvel,
             Verror=Verr,
             step=state.step + 1,
-            rng=rng,
             coord_last_update=coord_last_update,
             nan_round=nan_round,
+        )
+        return fields, update, Vvel, Verr
+
+    def _commit_step(self, state: FedState, lr: jax.Array, cs=None):
+        """Server half of the round: normalize the buffered aggregate,
+        run the mode's momentum+EF update (core/server.py — identical
+        code to the sync round), apply it to the weights, and reset the
+        buffer. ``step`` advances here: it is the server version."""
+        rng, server_rng = jax.random.split(state.rng)
+        total = jnp.maximum(state.async_buffer_n, 1.0)
+        agg = state.async_buffer / total
+        fields, update, Vvel, Verr = self._server_tail_fields(
+            state, agg, lr, server_rng, cs)
+        new_state = state.replace(
+            rng=rng,
             async_buffer=jnp.zeros_like(state.async_buffer),
             async_buffer_n=jnp.zeros_like(state.async_buffer_n),
-        )
+            **fields)
         # commit health scalars for the async_round telemetry event: the
         # post-commit EF-accumulator norms are the staleness-divergence
         # signal telemetry/health.py watches
@@ -1422,6 +1568,29 @@ class FedRuntime:
         }
         return new_state, metrics
 
+    def _decode_step(self, state: FedState, cohort_sum: jax.Array,
+                     n_total: jax.Array, lr: jax.Array, cs=None
+                     ) -> FedState:
+        """Server half of the --decode_overlap split round: the commit
+        step WITHOUT the async buffer — the cohort's unnormalized sum
+        arrives as an argument (the buffer at K=1/M=1 is a pure pytree
+        swap, so skipping it changes nothing; FedState keeps its sync
+        template and checkpoints stay vintage-compatible). Dispatched as
+        its own executable so the decode/top-k uncompress of round t
+        runs while the host stages round t+1's client block, and a
+        metrics sync on the cohort outputs returns without waiting the
+        decode out. Numerically the sync round's server tail verbatim
+        (losses bit-identical — dryrun-asserted, the PR-5 gate
+        pattern). Returns ONLY the new state: with the per-round
+        signals off under the split, nothing reads post-decode norms —
+        emitting them as executable outputs would force a (d,)-sized
+        reduction per round that XLA cannot DCE."""
+        rng, server_rng = jax.random.split(state.rng)
+        agg = cohort_sum / jnp.maximum(n_total, 1.0)
+        fields, _update, _Vvel, _Verr = self._server_tail_fields(
+            state, agg, lr, server_rng, cs)
+        return state.replace(rng=rng, **fields)
+
     def _prep_lr(self, lr) -> jax.Array:
         lr = jnp.asarray(lr, jnp.float32)
         if lr.ndim == 1 and lr.shape[0] != self.d_pad:
@@ -1431,11 +1600,12 @@ class FedRuntime:
 
     def cohort(self, state: FedState, client_ids, batch, mask, lr
                ) -> Tuple[FedState, Dict]:
-        """Dispatch one cohort's client compute (async mode). Same
-        argument contract as :meth:`round`; returns (state', payload)
-        where payload carries the unnormalized transmitted-space sum the
-        AsyncAggregator later merges."""
-        assert self._cohort is not None, "--async_agg is off"
+        """Dispatch one cohort's client compute (async or decode-overlap
+        mode). Same argument contract as :meth:`round`; returns (state',
+        payload) where payload carries the unnormalized transmitted-space
+        sum the AsyncAggregator merges (or :meth:`decode` consumes)."""
+        assert self._cohort is not None, \
+            "neither --async_agg nor --decode_overlap is on"
         with tracing.span("cohort_dispatch"):
             return self._cohort(state, jnp.asarray(client_ids, jnp.int32),
                                 batch, jnp.asarray(mask),
@@ -1473,6 +1643,16 @@ class FedRuntime:
         assert self._commit_jit is not None, "--async_agg is off"
         with tracing.span("commit_dispatch"):
             return self._commit_jit(state, self._prep_lr(lr), self.cs)
+
+    def decode(self, state: FedState, cohort_sum, n_total, lr
+               ) -> FedState:
+        """Run the --decode_overlap server half on one cohort payload
+        (core/pipeline.DecodeOverlapRound). Returns the new state."""
+        assert self._decode_jit is not None, "--decode_overlap is off"
+        with tracing.span("decode_dispatch"):
+            return self._decode_jit(state, cohort_sum,
+                                    jnp.asarray(n_total, jnp.float32),
+                                    self._prep_lr(lr), self.cs)
 
     # -------------------------------------------------------------- user API
 

@@ -1,0 +1,218 @@
+"""StreamMLP: the reference scan-structured model for the fused sketch
+encode's ``streaming_grad`` hook (core/client.py make_forward_grad /
+make_fused_grad).
+
+Why this exists
+---------------
+The generic fused-encode path differentiates the loss w.r.t. the
+parameter PYTREE and streams each leaf cotangent into the Count Sketch
+table (``encode_grad_tree``) — the dense ``(d,)`` gradient sum never
+exists, but the backward still PRODUCES every leaf cotangent before XLA
+schedules the first encode, so roughly the whole ``d``-float tree sits
+live at the backward's end (~1.9x ``d*4`` temp measured on the CPU
+ledger, vs the theoretical one-layer-at-a-time interleave). The only
+way below ``d*4`` is a backward that *consumes each layer's gradient as
+it is produced* — which means the model must own its backward.
+
+``StreamMLP`` is that model, the miniature of GPT-2's scan-over-blocks
+structure: ``L`` identical dense+relu blocks whose parameters are one
+stacked ``(L, H, H)`` leaf. ``make_stream_mlp_loss`` builds the
+standard ``loss_fn(params, batch, mask) -> (loss, (acc,))`` closure AND
+attaches the ``streaming_grad`` implementation:
+
+- forward keeps the per-layer inputs (``(L, B, H)`` — activations, not
+  parameters: tiny) and reads each layer's weights ON DEMAND with a
+  ``dynamic_slice`` of ``params_vec`` inside the layer scan — the
+  stacked ``(L, H, H)`` leaf is never materialized, so the weights
+  stay in ARGUMENT space (a whole-tree ``unravel`` would put a second
+  d-sized copy in temp and single-handedly blow the ``< d*4`` gate);
+- the backward walks layers LAST to FIRST (the natural cotangent
+  order), computes one layer's ``(H, H)`` weight gradient, encodes it
+  into the carry table at its static ravel offset, and — the part no
+  generic autodiff pipeline can do — couples the next layer's
+  activation cotangent to the updated table with a
+  ``lax.optimization_barrier``, so the schedule PROVABLY holds at most
+  one layer's parameter gradient live at a time. The barrier alone is
+  not enough: the layer's weight slice and the encode's ±1 sign
+  streams are pure index arithmetic, which the scheduler would
+  otherwise compute UP FRONT for every layer at once (measured: 24
+  concurrent sign tensors — r·L ranges — put the "streaming" backward
+  right back at 4x d*4). Both are therefore keyed on an opaque zero
+  derived from the barrier-chained cotangent (``loop_token_zero``), so
+  layer l's slices and signs cannot exist before layer l+1's encode
+  completed. Peak temp is ``O(d/L + B·H·L + r·c)`` — under ``d*4``
+  whenever the model has more than a couple of blocks (the
+  dryrun_multichip fused-encode gate asserts exactly this on the split
+  round's client executable).
+
+The manual VJP is pinned against ``jax.grad`` by
+tests/test_fused_encode.py (same cotangents to fp tolerance), and the
+streamed table against encode(dense gradient) by sketch linearity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from commefficient_tpu.ops.sketch import loop_token_zero
+
+
+def init_stream_mlp(key: jax.Array, d_in: int, hidden: int, n_layers: int,
+                    n_classes: int, scale: float = 0.3) -> Dict[str, Any]:
+    """Parameter pytree: ``inp`` (d_in, H), ``blocks_w`` (L, H, H),
+    ``blocks_b`` (L, H), ``out`` (H, C). Plain dict — ``ravel_params``
+    flattens leaves in sorted-key order (blocks_b, blocks_w, inp, out),
+    which is the layout ``streaming_grad``'s static offsets assume."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    h = hidden
+    return {
+        "blocks_b": jnp.zeros((n_layers, h), jnp.float32),
+        "blocks_w": scale * jax.random.normal(k1, (n_layers, h, h),
+                                              jnp.float32) / jnp.sqrt(h),
+        "inp": scale * jax.random.normal(k2, (d_in, h),
+                                         jnp.float32) / jnp.sqrt(d_in),
+        "out": scale * jax.random.normal(k3, (h, n_classes),
+                                         jnp.float32) / jnp.sqrt(h),
+    }
+
+
+def _forward(params: Dict[str, Any], x: jax.Array
+             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (logits, hs, h_final) with ``hs[l]`` the INPUT of block l
+    (hs: (L, B, H) — the backward's saved activations) and ``h_final``
+    the last block's output (the output head's input)."""
+
+    h = x @ params["inp"]
+
+    def body(h, wb):
+        w, b = wb
+        return jax.nn.relu(h @ w + b), h
+
+    h, hs = lax.scan(body, h, (params["blocks_w"], params["blocks_b"]))
+    return h @ params["out"], hs, h
+
+
+def make_stream_mlp_loss(params_template: Dict[str, Any]):
+    """Build the driver-contract loss closure for a StreamMLP parameter
+    tree and attach its ``streaming_grad``.
+
+    ``loss_fn(params, batch, mask) -> (masked-mean NLL, (accuracy,))``
+    with ``batch = {"x": (B, d_in), "target": (B,)}``; and
+
+    ``loss_fn.streaming_grad(params_vec, batch, mask, cs, table,
+    scale=None) -> (table', loss, metrics)``
+
+    where ``table' == table + cs.encode(scale * dense_grad)`` up to fp
+    order and ``dense_grad`` is exactly ``jax.grad`` of the same loss in
+    ravel layout (test-pinned). ``scale`` folds into the logits
+    cotangent — everything downstream is linear in it."""
+    L, H = params_template["blocks_w"].shape[:2]
+    d_in = params_template["inp"].shape[0]
+    C = params_template["out"].shape[1]
+    # static ravel offsets of the sorted-key leaf layout
+    off_b = 0
+    off_w = off_b + L * H
+    off_inp = off_w + L * H * H
+    off_out = off_inp + d_in * H
+
+    def _loss_from_logits(logits, target, mask):
+        m = mask.astype(jnp.float32)
+        denom = jnp.maximum(m.sum(), 1.0)
+        lp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(lp, target[:, None], axis=1)[:, 0]
+        loss = (nll * m).sum() / denom
+        acc = ((logits.argmax(axis=1) == target) * m).sum() / denom
+        return loss, acc
+
+    def loss_fn(params, batch, mask):
+        logits, _, _ = _forward(params, batch["x"])
+        loss, acc = _loss_from_logits(logits, batch["target"], mask)
+        return loss, (acc,)
+
+    def _slice(params_vec, start, n, zi=None):
+        """One leaf range of ``params_vec``, read in place. ``zi`` is an
+        opaque zero offset (see loop_token_zero) serializing the slice
+        behind the backward's barrier chain — without it every layer's
+        weight slice is loop-invariant index arithmetic the scheduler
+        happily materializes up front, all L at once."""
+        if zi is not None:
+            start = start + zi
+        return lax.dynamic_slice(params_vec, (start,), (n,))
+
+    def streaming_grad(params_vec, batch, mask, cs, table, scale=None):
+        x, target = batch["x"], batch["target"]
+        # forward: layer weights are dynamic-sliced from params_vec one
+        # layer at a time inside the scan — numerically the exact dots
+        # of loss_fn's pytree forward (slice+reshape changes no values),
+        # but the (L, H, H) stacked leaf never exists as a buffer
+        h0 = x @ _slice(params_vec, off_inp, d_in * H).reshape(d_in, H)
+
+        def fwd_body(h, l):
+            w = _slice(params_vec, off_w + l * H * H, H * H).reshape(H, H)
+            b = _slice(params_vec, off_b + l * H, H)
+            return jax.nn.relu(h @ w + b), h
+
+        h_last, hs = lax.scan(fwd_body, h0,
+                              jnp.arange(L, dtype=jnp.int32))
+        w_out = _slice(params_vec, off_out, H * C).reshape(H, C)
+        logits = h_last @ w_out
+        m = mask.astype(jnp.float32)
+        denom = jnp.maximum(m.sum(), 1.0)
+        loss, acc = _loss_from_logits(logits, target, mask)
+        # d(loss)/d(logits) of the masked-mean NLL; the client's datum
+        # weighting (``scale``) folds in here — every parameter
+        # cotangent below is linear in it
+        p = jax.nn.softmax(logits)
+        dlogits = (p - jax.nn.one_hot(target, C)) * (m / denom)[:, None]
+        if scale is not None:
+            dlogits = dlogits * scale
+        # output head: its cotangent is produced first and dies at its
+        # encode — exactly the discipline the generic tree path cannot
+        # force on XLA's scheduler
+        table = cs.encode_accum(table, (h_last.T @ dlogits).reshape(-1),
+                                off_out, token=loss)
+        dh = dlogits @ w_out.T
+        for l in range(L - 1, -1, -1):
+            # the token is re-derived from the BARRIER-CHAINED cotangent
+            # each layer: this layer's weight slice AND its encodes'
+            # sign streams now depend on the previous layer's encode
+            # having completed, not just on the loss
+            tok = dh[0, 0]
+            zi = loop_token_zero(tok).astype(jnp.int32)
+            w = _slice(params_vec, off_w + l * H * H, H * H,
+                       zi).reshape(H, H)
+            b = _slice(params_vec, off_b + l * H, H, zi)
+            z = hs[l] @ w + b
+            dz = dh * (z > 0)
+            table = cs.encode_accum(table, (hs[l].T @ dz).reshape(-1),
+                                    off_w + l * H * H, token=tok)
+            table = cs.encode_accum(table, dz.sum(axis=0),
+                                    off_b + l * H, token=tok)
+            dh = dz @ w.T
+            # the coupling is the whole trick: the NEXT layer's backward
+            # must depend on THIS layer's encode having completed, so
+            # the scheduler cannot run the full backward first and park
+            # every layer's (H, H) cotangent in HBM — at most one is
+            # live at any point (the dryrun gate's temp < d*4 proof).
+            # An optimization_barrier is NOT enough: the CPU pipeline
+            # expands barriers away before scheduling (76 in the
+            # unoptimized module, 0 after optimization — measured), so
+            # the dependency must be DATA: an opaque zero derived from
+            # the updated table (un-foldable for the same fp reasons as
+            # loop_token_zero: x*0 is NaN for nonfinite x, so the
+            # simplifier cannot elide it; the NaN squash keeps a
+            # diverging table from poisoning the cotangent) folds into
+            # dh, and the barrier stays for backends that do honor it
+            tz = table[0, 0] * 0.0
+            dh = dh + jnp.where(jnp.isnan(tz), 0.0, tz)
+            dh, table = lax.optimization_barrier((dh, table))
+        table = cs.encode_accum(table, (x.T @ dh).reshape(-1), off_inp,
+                                token=dh[0, 0])
+        return table, loss, (acc,)
+
+    loss_fn.streaming_grad = streaming_grad
+    return loss_fn

@@ -63,7 +63,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from commefficient_tpu.ops.sketch import _mix32
+from commefficient_tpu.ops.sketch import _mix32, loop_token_zero
 from commefficient_tpu.ops.topk import (clip_by_l2_norm, median_axis0, topk,
                                         topk_with_idx)
 
@@ -206,6 +206,71 @@ class CirculantSketch:
                     sv, self._row_shift_idx(j, sign=1), axis=1)
             rows.append(rolled.sum(axis=0))
         return jnp.stack(rows)
+
+    def encode_accum(self, table: jax.Array, vals: jax.Array,
+                     start: int = 0, scale=None,
+                     token: Optional[jax.Array] = None) -> jax.Array:
+        """Accumulating range encode: ``table + encode(v)`` for the
+        vector ``v`` holding ``vals`` at global coordinates
+        ``[start, start + len(vals))`` and zero elsewhere — without ever
+        materializing a (d,)-sized buffer (only this range's blocks are
+        resident). The streaming entry point of the fused-encode client
+        path (core/client.py): per-microbatch gradients accumulate into
+        the O(r·c) carry, chunk by chunk.
+
+        ``start`` must be a STATIC python int (the per-block shifts are
+        compile-time constants — that is what makes the roll path
+        scatter-free; a traced-offset caller should use
+        :meth:`encode_vals_at`, whose bucket map is pure arithmetic).
+        ``scale`` multiplies the values before encoding (linearity);
+        ``token`` is any loop-varying scalar defeating while-loop sign
+        hoisting (ops/sketch.py loop_token_zero). The whole-vector call
+        (``start == 0``, full d) routes through the fused Pallas encode
+        kernel when eligible — the accumulate is then one table add."""
+        assert vals.ndim == 1, vals.shape
+        assert table.shape == self.table_shape, (table.shape,
+                                                 self.table_shape)
+        start = int(start)
+        assert start >= 0 and start + vals.shape[0] <= self.m * self.c, (
+            start, vals.shape, self.d)
+        vals = vals.astype(jnp.float32)
+        if scale is not None:
+            vals = vals * scale
+        m, c = self.m, self.c
+        if start == 0 and vals.shape[0] == self.d \
+                and self._use_pallas_encode():
+            from commefficient_tpu.ops.circulant_pallas import pallas_encode
+            vp = jnp.pad(vals, (0, m * c - self.d))
+            return table + pallas_encode(
+                vp, jnp.asarray(self.shifts, jnp.int32), self.sign_keys,
+                c=c, r=self.r, m=m)
+        n = vals.shape[0]
+        b0 = start // c
+        o0 = start - b0 * c
+        nb = -(-(o0 + n) // c)
+        vp = jnp.pad(vals, (o0, nb * c - o0 - n)).reshape(nb, c)
+        zu = loop_token_zero(token)
+        # the token is folded into the SCALAR offset before it meets the
+        # iota: written ``const + arange + zu`` (left-assoc), the
+        # ``const + arange`` pair is a nullary all-constant fusion XLA
+        # hoists out of the scan and keeps resident for every range at
+        # once (measured: L per-layer u32 base vectors alive together on
+        # the streaming-backward path); ``arange + (zu + const)`` keeps
+        # every index vector data-dependent on the loop-varying token
+        idx0 = jnp.arange(nb * c, dtype=_U32) + (zu + _U32(b0 * c))
+        for j in range(self.r):
+            signs = self._sign_of(j, idx0).reshape(nb, c)
+            sv = signs * vp
+            if nb <= self._UNROLL_MAX_BLOCKS:
+                rolled = jnp.stack(
+                    [jnp.roll(sv[b], self.shifts[j][b0 + b])
+                     for b in range(nb)])
+            else:
+                rolled = jnp.take_along_axis(
+                    sv, self._row_shift_idx(j, sign=1, b0=b0, nb=nb),
+                    axis=1)
+            table = table.at[j].add(rolled.sum(axis=0))
+        return table
 
     def _buckets_of(self, j: int, idx: jax.Array) -> jax.Array:
         """Bucket of global coordinate i in row j:

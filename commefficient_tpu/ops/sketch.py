@@ -30,7 +30,7 @@ TPU-first design decisions:
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -87,6 +87,12 @@ class CountSketch:
     # server are implementation-agnostic
     def encode(self, vec: jax.Array) -> jax.Array:
         return sketch_encode(self, vec)
+
+    def encode_accum(self, table: jax.Array, vals: jax.Array,
+                     start: int = 0, scale=None,
+                     token: Optional[jax.Array] = None) -> jax.Array:
+        return sketch_encode_accum(self, table, vals, start=start,
+                                   scale=scale, token=token)
 
     def encode_at(self, vec: jax.Array, idx: jax.Array) -> jax.Array:
         return sketch_encode_at(self, vec, idx)
@@ -199,6 +205,84 @@ def sketch_encode(cs: CountSketch, vec: jax.Array) -> jax.Array:
 
     table, _ = lax.scan(body, cs.empty_table(),
                         (jnp.arange(nb, dtype=_U32), blocks))
+    return table
+
+
+def loop_token_zero(token: Optional[jax.Array]) -> jax.Array:
+    """A uint32 zero that XLA cannot prove is zero, derived from any
+    loop-varying scalar ``token`` (e.g. the microbatch loss).
+
+    Why this exists: the streaming/accumulating encodes below recompute
+    their ±1 sign streams from pure index arithmetic — loop-INVARIANT
+    computations when the encode runs inside a ``lax.scan`` body. XLA's
+    while-loop invariant code motion then hoists every sign tensor out
+    of the scan and keeps all of them RESIDENT for the scan's whole
+    lifetime (r x d floats — 3x the dense gradient the fused encode
+    exists to kill; measured 6.7x d·4 temp on the CPU backend). Adding
+    this opaque zero to the index stream makes the signs depend on the
+    loop iteration, so they are recomputed per step (the module's design
+    principle: vector ALU is cheaper than HBM residency).
+
+    Robust to non-finite tokens: ``token * 0`` is NaN for inf/NaN
+    inputs, so the NaN is explicitly squashed back to zero BEFORE the
+    integer conversion — a diverging loss must never scramble bucket
+    indices (quarantine/abort still see NaN table CELLS from the NaN
+    values themselves). ``token=None`` returns a plain zero (no-op).
+    """
+    if token is None:
+        return _U32(0)
+    t0 = token.astype(jnp.float32) * 0.0
+    t0 = jnp.where(jnp.isnan(t0), 0.0, t0)
+    return lax.optimization_barrier(t0).astype(_U32)
+
+
+def sketch_encode_accum(cs: CountSketch, table: jax.Array, vals: jax.Array,
+                        start: int = 0, scale=None,
+                        token: Optional[jax.Array] = None) -> jax.Array:
+    """Accumulating range encode: add the sketch of a contiguous
+    coordinate range to a carry ``table``.
+
+    ``vals`` holds the values of global coordinates ``[start, start +
+    len(vals))``; the result equals ``table + sketch_encode(cs, v)``
+    for ``v`` zero outside the range (up to fp addition order). This is
+    the streaming entry point the fused-encode client path accumulates
+    per-microbatch gradients through (core/client.py): the carry is the
+    O(r·c) table, and only this range's values are ever resident.
+    ``scale`` multiplies the values before encoding (sketch linearity:
+    ``encode(s*v) == s*encode(v)``); ``token`` see loop_token_zero.
+    ``start`` may be a python int or a traced scalar (the hash bucket
+    map is pure index arithmetic)."""
+    assert vals.ndim == 1, vals.shape
+    assert table.shape == cs.table_shape, (table.shape, cs.table_shape)
+    vals = vals.astype(jnp.float32)
+    if scale is not None:
+        vals = vals * scale
+    zu = loop_token_zero(token)
+    n = vals.shape[0]
+    bl = cs.block_len
+    nb = -(-n // bl)
+    vals_p = jnp.pad(vals, (0, nb * bl - n))
+    # scalar (start + zu) first: see CirculantSketch.encode_accum — an
+    # ``arange + start`` pair with a static start is an all-constant
+    # fusion XLA hoists and keeps resident per call site
+    base = (jnp.arange(bl, dtype=_U32)
+            + (jnp.asarray(start).astype(_U32) + zu))
+
+    def body(tbl, args):
+        b_idx, block_vals = args
+        buckets, signs = _buckets_signs(cs, base + b_idx * _U32(bl))
+        sv = signs * block_vals[None, :]
+        contrib = jax.vmap(
+            lambda b, v: jax.ops.segment_sum(v, b, num_segments=cs.c)
+        )(buckets, sv)
+        return tbl + contrib, None
+
+    if nb == 1:
+        table, _ = body(table, (_U32(0), vals_p))
+        return table
+    table, _ = lax.scan(body, table,
+                        (jnp.arange(nb, dtype=_U32),
+                         vals_p.reshape(nb, bl)))
     return table
 
 

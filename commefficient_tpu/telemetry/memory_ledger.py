@@ -43,15 +43,19 @@ MEMORY_LEDGER_KEYS = ("temp_bytes", "argument_bytes", "output_bytes",
 MEMORY_KEYS = ("live_bytes", "peak_bytes", "delta_peak_bytes",
                "fragmentation_bytes", "limit_bytes", "headroom_frac")
 
-# The staged acceptance gate for ROADMAP item 1's encode-fusion work:
-# today the sketch-mode round MATERIALIZES the dense (d,) f32 aggregated
-# gradient before encoding it (temp_bytes >= d*4 — measured and
-# committed by dryrun_multichip's sketch gate), which is the structural
-# HBM suspect behind the flat GPT-2 MFU. The fusion PR (encode inside
-# the microbatch accumulator scan, accumulating in table space) flips
-# this flag to True, inverting the gate to temp_bytes < d*4 — the
-# committed proof that the dense gradient no longer hits HBM.
-SKETCH_ENCODE_FUSED = False
+# The acceptance gate ROADMAP item 1's encode-fusion work committed to
+# flip (PR 8 staged it False): the sketch-mode round used to
+# MATERIALIZE the dense (d,) f32 aggregated gradient before encoding it
+# (temp_bytes >= d*4 — the structural HBM suspect behind the flat GPT-2
+# MFU). With the fused encode (core/client.py make_forward_grad /
+# make_fused_grad: the microbatch scan carries the (r, c) sketch table,
+# --sketch_fused_encode) the dense gradient never exists, so the
+# dryrun_multichip sketch gate now asserts the INVERSE: temp_bytes <
+# d*4 — a regression that re-materializes the dense aggregate fails the
+# dryrun. check_dense_grad_floor(fused=False) keeps the pre-fusion
+# direction testable (and gates the explicit --sketch_fused_encode off
+# arm).
+SKETCH_ENCODE_FUSED = True
 
 # attribute name on the CompiledMemoryStats object -> ledger field
 _STATS_ATTRS = {

@@ -31,6 +31,31 @@ def shard_map(f, **kwargs):
     return _shard_map(f, **kwargs)
 
 
+def _ensure_optimization_barrier_batching() -> None:
+    """Register the vmap rule for ``lax.optimization_barrier`` on jax
+    lines that lack it (0.4.x raises NotImplementedError — hit by the
+    fused sketch encode's per-client vmap path, whose streaming encodes
+    carry barrier-chained scheduling tokens; newer jax ships exactly
+    this rule). The barrier is semantically the identity on each
+    operand, so batching passes the batch dims straight through."""
+    try:
+        from jax._src.interpreters import batching
+        from jax._src.lax.lax import optimization_barrier_p
+    except ImportError:  # pragma: no cover - internals moved; newer jax
+        return           # lines ship the rule anyway
+    if optimization_barrier_p in batching.primitive_batchers:
+        return
+
+    def _rule(args, dims, **params):
+        out = optimization_barrier_p.bind(*args, **params)
+        return out, dims
+
+    batching.primitive_batchers[optimization_barrier_p] = _rule
+
+
+_ensure_optimization_barrier_batching()
+
+
 def pcast(x, axis_name, to="varying"):
     """``lax.pcast`` where it exists; identity elsewhere. The call only
     exists to mark replicated values as device-varying for the vma

@@ -50,6 +50,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # full remat, microbatch 8, lm_chunk 128)
 ARMS = {
     "base": {},
+    # the PR-9 A/B: base now runs the FUSED sketch encode (the
+    # microbatch scan carries the table; --sketch_fused_encode auto);
+    # this arm forces the pre-fusion round whose ledger documents the
+    # dense (d,) gradient materialization — the temp_bytes delta
+    # between the two is the committed proof the floor moved
+    # (runs/BREAKDOWN_gpt2.md §Round 7)
+    "unfused_encode": {"fused_encode": "off"},
+    # split-round arms (--decode_overlap): the decode of round t runs
+    # while round t+1 stages, and the COHORT executable's ledger
+    # isolates the client block — the granularity where the fused
+    # encode's temp drop is measurable at all (the monolithic round's
+    # peak is shared with the server decode's own dense buffers)
+    "overlap": {"decode_overlap": True},
+    "overlap_unfused": {"decode_overlap": True, "fused_encode": "off"},
     "no_remat": {"remat": False},
     "policy_dots": {"remat_policy": "dots_saveable"},
     "mb4": {"microbatch": 4},
@@ -88,6 +102,13 @@ def main(argv=None) -> int:
                          "fields per arm, but the throughput numbers "
                          "are NOT the flagship measurement — each line "
                          "carries dryrun: true")
+    ap.add_argument("--ledger_ab", action="store_true",
+                    help="append the compile-only fused-vs-unfused "
+                         "cohort-ledger A/B at a parameter-dominated "
+                         "GPT-2 geometry (bench_gpt2.ledger_ab) — the "
+                         "committed dense-gradient-floor proof for "
+                         "runs/BREAKDOWN_gpt2.md §Round 7; honors "
+                         "--dryrun")
     args = ap.parse_args(argv)
 
     import bench_gpt2
@@ -125,6 +146,20 @@ def main(argv=None) -> int:
             f.flush()
             os.fsync(f.fileno())
             results.append(rec)
+        if args.ledger_ab:
+            log("=== ledger_ab: compile-only fused-vs-unfused cohort "
+                "ledgers (parameter-dominated geometry)")
+            rec = {"arm": "ledger_ab"}
+            if args.dryrun:
+                rec["dryrun"] = True
+            try:
+                rec["result"] = bench_gpt2.ledger_ab(dryrun=args.dryrun)
+            except Exception as e:
+                log(traceback.format_exc())
+                rec["error"] = f"{type(e).__name__}: {e}"
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
 
     ok = [r for r in results if r.get("result", {}).get("mfu") is not None]
     if ok:

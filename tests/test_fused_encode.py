@@ -1,0 +1,449 @@
+"""Fused sketch encode (core/client.py + ops/sketch.py + ops/circulant.py)
+and decode overlap (core/pipeline.DecodeOverlapRound):
+
+- the streaming/accumulating encode entry points against dense-encode
+  references (sketch linearity: ``table + encode(v)``, range offsets,
+  scale folding, the loop-token contract);
+- ``encode_grad_tree`` leaf coalescing/splitting against ``encode(ravel)``;
+- StreamMLP's hand-written ``streaming_grad`` against ``jax.grad`` of the
+  same loss (the manual-VJP contract of models/stream_mlp.py);
+- fused-encode rounds == unfused rounds within fp tolerance on the
+  fused-clients scan AND the vmap path, incl. masked/zero-datum clients
+  and update-space adversary injection (which acts on the table);
+- HLO byte-identity where the fused encode must be invisible (non-sketch
+  modes; auto-with-blocker == explicit off);
+- the --sketch_fused_encode on fail-fast and --decode_overlap
+  validation guards, and the split round's bit-identity to the
+  monolithic round (the PR-5 pipeline-gate pattern, server-side);
+- the blocked-scan download-byte accounting against the numpy reference
+  (the (W, d) broadcast it replaced was the round's largest temp).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.flatten_util import ravel_pytree
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.core import (DecodeOverlapRound, FedRuntime,
+                                    validate_overlap_combo)
+from commefficient_tpu.core.client import (encode_grad_tree,
+                                           fused_encode_blockers)
+from commefficient_tpu.models.stream_mlp import (init_stream_mlp,
+                                                 make_stream_mlp_loss)
+from commefficient_tpu.ops.sketch import (loop_token_zero, make_sketch_impl,
+                                          sketch_encode_accum)
+from tests.test_parallel import make_batch, quad_loss
+
+W, B = 4, 4
+
+
+def make_cfg(**kw):
+    base = dict(mode="sketch", error_type="virtual", k=5, num_rows=3,
+                num_cols=32, num_blocks=2, sketch_impl="hash",
+                local_momentum=0.0, virtual_momentum=0.9,
+                weight_decay=0.0, num_workers=W, local_batch_size=B,
+                track_bytes=True, num_clients=16, microbatch_size=2)
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def make_params(seed=0):
+    return {"w": jnp.asarray(np.random.RandomState(seed).randn(6, 3),
+                             jnp.float32)}
+
+
+def run_rounds(cfg, n=3, params=None, loss_fn=quad_loss, seed=0):
+    rt = FedRuntime(cfg, params or make_params(), loss_fn, num_clients=16)
+    state = rt.init_state()
+    batch, mask, ids = make_batch(seed, W=W, B=B)
+    losses = []
+    for _ in range(n):
+        state, m = rt.round(state, ids, batch, mask, 0.1)
+        losses.append(np.asarray(m["results"][0]))
+    return rt, np.stack(losses), state
+
+
+# --------------------------------------------------------- streaming encodes
+
+
+@pytest.mark.parametrize("impl", ["hash", "circ"])
+def test_encode_accum_matches_dense_encode(impl):
+    """``table + encode_accum(vals @ start)`` == ``table + encode(v)``
+    for v zero outside the range — for interior ranges, the full vector,
+    and with a scale folded in (sketch linearity)."""
+    d = 1000
+    cs = make_sketch_impl(impl, d=d, c=64, r=3, num_blocks=4)
+    rng = np.random.RandomState(3)
+    table0 = jnp.asarray(rng.randn(3, 64), jnp.float32)
+    for start, n in ((0, d), (0, 17), (128, 300), (d - 33, 33)):
+        vals = jnp.asarray(rng.randn(n), jnp.float32)
+        dense = jnp.zeros(d).at[start:start + n].set(vals)
+        ref = table0 + cs.encode(dense)
+        got = cs.encode_accum(table0, vals, start)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        got_s = cs.encode_accum(table0, vals, start,
+                                scale=jnp.asarray(2.5, jnp.float32),
+                                token=jnp.asarray(1.7, jnp.float32))
+        ref_s = table0 + 2.5 * cs.encode(dense)
+        np.testing.assert_allclose(np.asarray(got_s), np.asarray(ref_s),
+                                   rtol=1e-5, atol=1e-4)
+
+
+def test_encode_accum_under_jit_and_scan():
+    """The streaming encode composes with jit + lax.scan (the fused
+    client path's actual shape: per-step encodes into a carried table)
+    and the result equals the one-shot encode of the summed vector."""
+    d = 257
+    cs = make_sketch_impl("hash", d=d, c=32, r=3, num_blocks=2)
+    rng = np.random.RandomState(0)
+    vs = jnp.asarray(rng.randn(5, d), jnp.float32)
+
+    @jax.jit
+    def stream(vs):
+        def body(tbl, v):
+            return sketch_encode_accum(cs, tbl, v, 0, token=v[0]), None
+        tbl, _ = jax.lax.scan(body, jnp.zeros((3, 32)), vs)
+        return tbl
+
+    ref = cs.encode(vs.sum(axis=0))
+    np.testing.assert_allclose(np.asarray(stream(vs)), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_loop_token_zero_contract():
+    """The opaque zero is EXACTLY zero for every token — finite, inf,
+    nan (a diverging loss must never scramble bucket indices) — and
+    None degrades to a plain zero."""
+    for tok in (0.0, 3.7, -1e30, np.inf, -np.inf, np.nan):
+        z = jax.jit(loop_token_zero)(jnp.asarray(tok, jnp.float32))
+        assert int(z) == 0, (tok, z)
+        assert z.dtype == jnp.uint32
+    assert int(loop_token_zero(None)) == 0
+
+
+@pytest.mark.parametrize("impl", ["hash", "circ"])
+def test_encode_grad_tree_matches_ravel_encode(impl):
+    """Leaf-range streaming over a mixed pytree (tiny bias leaves that
+    coalesce, a large kernel that splits) equals the one-shot encode of
+    the raveled tree; a scale folds in linearly."""
+    rng = np.random.RandomState(1)
+    gtree = {
+        "a_bias": jnp.asarray(rng.randn(7), jnp.float32),
+        "b_kernel": jnp.asarray(rng.randn(90, 30), jnp.float32),
+        "c_bias": jnp.asarray(rng.randn(11), jnp.float32),
+        "d_kernel": jnp.asarray(rng.randn(40, 10), jnp.float32),
+    }
+    flat, _ = ravel_pytree(gtree)
+    d = flat.shape[0]
+    cs = make_sketch_impl(impl, d=d, c=128, r=3, num_blocks=4)
+    table0 = jnp.zeros((3, 128))
+    ref = cs.encode(flat)
+    # min/max chunk sizes chosen to force BOTH the coalesce path (7- and
+    # 11-element biases) and the split path (the 2700-element kernel)
+    got = encode_grad_tree(cs, table0, gtree, min_chunk=64, max_chunk=512)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+    got_s = encode_grad_tree(cs, table0, gtree,
+                             scale=jnp.asarray(0.5, jnp.float32),
+                             token=jnp.asarray(2.0, jnp.float32),
+                             min_chunk=64, max_chunk=512)
+    np.testing.assert_allclose(np.asarray(got_s), 0.5 * np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_streaming_grad_matches_jax_grad():
+    """models/stream_mlp.py's manual VJP: the streamed table equals
+    encode(jax.grad) of the same loss in ravel layout, the loss matches
+    the pytree forward, and the client datum-count scale folds in."""
+    params = init_stream_mlp(jax.random.PRNGKey(0), d_in=16, hidden=32,
+                             n_layers=6, n_classes=5)
+    loss_fn = make_stream_mlp_loss(params)
+    pv, unravel = ravel_pytree(params)
+    d = pv.shape[0]
+    rng = np.random.RandomState(2)
+    batch = {"x": jnp.asarray(rng.randn(8, 16), jnp.float32),
+             "target": jnp.asarray(rng.randint(0, 5, (8,)), jnp.int32)}
+    mask = jnp.asarray([1, 1, 1, 0, 1, 1, 0, 1], bool)
+
+    def loss_vec(v):
+        loss, _ = loss_fn(unravel(v), batch, mask)
+        return loss
+
+    g = jax.grad(loss_vec)(pv)
+    for impl in ("hash", "circ"):
+        cs = make_sketch_impl(impl, d=d, c=128, r=3, num_blocks=4)
+        t, loss_s, (acc_s,) = loss_fn.streaming_grad(
+            pv, batch, mask, cs, jnp.zeros((3, 128)))
+        np.testing.assert_allclose(float(loss_s), float(loss_vec(pv)),
+                                   rtol=1e-6)
+        ref = np.asarray(cs.encode(g))
+        np.testing.assert_allclose(np.asarray(t), ref, rtol=1e-4,
+                                   atol=1e-5)
+        t2, _, _ = loss_fn.streaming_grad(
+            pv, batch, mask, cs, jnp.zeros((3, 128)),
+            scale=jnp.asarray(3.0, jnp.float32))
+        np.testing.assert_allclose(np.asarray(t2), 3.0 * ref, rtol=1e-4,
+                                   atol=1e-4)
+
+
+# ------------------------------------------------------- runtime equivalence
+
+
+FUSED_LOSS_RTOL, FUSED_LOSS_ATOL = 1e-4, 1e-5
+
+
+def test_fused_round_matches_unfused_fused_clients_path():
+    rt_f, lf, sf = run_rounds(make_cfg(sketch_fused_encode="auto"))
+    rt_u, lu, su = run_rounds(make_cfg(sketch_fused_encode="off"))
+    assert rt_f._fused_encode and rt_f._fused
+    assert not rt_u._fused_encode
+    np.testing.assert_allclose(lf, lu, rtol=FUSED_LOSS_RTOL,
+                               atol=FUSED_LOSS_ATOL)
+    np.testing.assert_allclose(np.asarray(sf.ps_weights),
+                               np.asarray(su.ps_weights),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_round_matches_unfused_vmap_path():
+    """The per-client table-carry scan (make_client_step): per-client
+    grad stats are a blocker by design, so they are off here."""
+    kw = dict(fused_clients=False, client_stats=False)
+    rt_f, lf, sf = run_rounds(make_cfg(sketch_fused_encode="auto", **kw))
+    rt_u, lu, su = run_rounds(make_cfg(sketch_fused_encode="off", **kw))
+    assert rt_f._fused_encode and not rt_f._fused
+    np.testing.assert_allclose(lf, lu, rtol=FUSED_LOSS_RTOL,
+                               atol=FUSED_LOSS_ATOL)
+    np.testing.assert_allclose(np.asarray(sf.ps_weights),
+                               np.asarray(su.ps_weights),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_round_zero_datum_client():
+    """A fully-masked (zero-datum) client contributes NOTHING to the
+    table in both paths — fused == unfused with a benched slot, and the
+    benched slot's n_valid stays zero."""
+    batch, mask, ids = make_batch(5, W=W, B=B)
+    mask = jnp.asarray(np.asarray(mask)).at[1].set(False)
+
+    def run(fe, fused_clients):
+        cfg = make_cfg(sketch_fused_encode=fe, fused_clients=fused_clients,
+                       client_stats=False)
+        rt = FedRuntime(cfg, make_params(), quad_loss, num_clients=16)
+        state, m = rt.round(rt.init_state(), ids, batch, mask, 0.1)
+        return np.asarray(state.ps_weights), np.asarray(m["n_valid"])
+
+    for fc in (True, False):
+        wf, nf = run("auto", fc)
+        wu, nu = run("off", fc)
+        assert nf[1] == 0 and (nf == nu).all()
+        np.testing.assert_allclose(wf, wu, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("kind", ["signflip", "scale"])
+def test_fused_encode_with_adversary_injection(kind):
+    """Update-space injection acts on the TABLE under the fused encode
+    (the per-client transmitted quantity) — and because signflip/scale
+    commute with the linear encode, the attacked fused round still
+    matches the attacked unfused round within fp tolerance."""
+    kw = dict(fused_clients=False, client_stats=False, adversary=kind,
+              adversary_frac=0.6, adversary_scale=5.0)
+    rt_f, lf, sf = run_rounds(make_cfg(sketch_fused_encode="auto", **kw))
+    rt_u, lu, su = run_rounds(make_cfg(sketch_fused_encode="off", **kw))
+    assert rt_f._fused_encode and rt_f._adv_inject
+    np.testing.assert_allclose(lf, lu, rtol=FUSED_LOSS_RTOL,
+                               atol=FUSED_LOSS_ATOL)
+    np.testing.assert_allclose(np.asarray(sf.ps_weights),
+                               np.asarray(su.ps_weights),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_encode_table_frobenius_clip_stays_available():
+    """--max_grad_norm WITHOUT --sketch_dense_clip is the per-client
+    table-Frobenius clip — a per-table op the fused path keeps (the
+    reference semantics, fed_worker.py:318)."""
+    kw = dict(max_grad_norm=0.05, fused_clients=False, client_stats=False)
+    rt_f, lf, _ = run_rounds(make_cfg(sketch_fused_encode="auto", **kw))
+    rt_u, lu, _ = run_rounds(make_cfg(sketch_fused_encode="off", **kw))
+    assert rt_f._fused_encode
+    np.testing.assert_allclose(lf, lu, rtol=FUSED_LOSS_RTOL,
+                               atol=FUSED_LOSS_ATOL)
+
+
+# ----------------------------------------------------- soundness / fail-fast
+
+
+def test_fused_encode_blockers_unit():
+    assert fused_encode_blockers(make_cfg()) == []
+    assert fused_encode_blockers(make_cfg(mode="uncompressed",
+                                          error_type="none"))
+    assert any("sketch_dense_clip" in p for p in fused_encode_blockers(
+        make_cfg(sketch_dense_clip=True, max_grad_norm=1.0)))
+    assert any("privacy" in p for p in fused_encode_blockers(
+        make_cfg(do_dp=True, noise_multiplier=0.1)))
+    # --signals_exact blocks only when the signal diagnostics are LIVE
+    assert any("signals_exact" in p for p in fused_encode_blockers(
+        make_cfg(signals_exact=True), signals=True))
+    assert fused_encode_blockers(make_cfg(signals_exact=True),
+                                 signals=False) == []
+
+
+def test_fused_encode_on_fails_fast_with_explanation():
+    for kw, needle in ((dict(sketch_dense_clip=True, max_grad_norm=1.0),
+                        "sketch_dense_clip"),
+                       (dict(do_dp=True, noise_multiplier=0.1),
+                        "privacy"),
+                       (dict(signals_exact=True), "signals_exact")):
+        with pytest.raises(ValueError, match=needle):
+            FedRuntime(make_cfg(sketch_fused_encode="on", **kw),
+                       make_params(), quad_loss, num_clients=16)
+    # ... and auto with the same blockers silently falls back (the
+    # fallback IS the pre-fusion path)
+    rt = FedRuntime(make_cfg(sketch_fused_encode="auto",
+                             sketch_dense_clip=True, max_grad_norm=1.0),
+                    make_params(), quad_loss, num_clients=16)
+    assert not rt._fused_encode
+
+
+def test_fused_encode_on_requires_sketch_mode():
+    with pytest.raises(ValueError, match="mode sketch"):
+        make_cfg(mode="uncompressed", error_type="none",
+                 sketch_fused_encode="on")
+
+
+def test_fused_encode_auto_with_blocker_hlo_identical_to_off():
+    """auto's fallback must BE the old round: byte-identical HLO to the
+    explicit off spelling (numerics never change silently), and the
+    fused encode must be invisible to non-sketch modes entirely."""
+    batch, mask, ids = make_batch(0, W=W, B=B)
+    for kw in (dict(sketch_dense_clip=True, max_grad_norm=1.0),
+               dict(mode="uncompressed", error_type="none")):
+        rt_a = FedRuntime(make_cfg(sketch_fused_encode="auto", **kw),
+                          make_params(), quad_loss, num_clients=16)
+        rt_o = FedRuntime(make_cfg(sketch_fused_encode="off", **kw),
+                          make_params(), quad_loss, num_clients=16)
+        args = (rt_a.init_state(), ids, batch, mask,
+                jnp.asarray(0.1, jnp.float32), rt_a.cs)
+        assert (rt_a._round.lower(*args).as_text()
+                == rt_o._round.lower(*args).as_text()), kw
+    # sanity: where the fused encode ENGAGES, the lowering does change
+    rt_on = FedRuntime(make_cfg(sketch_fused_encode="auto"),
+                       make_params(), quad_loss, num_clients=16)
+    rt_off = FedRuntime(make_cfg(sketch_fused_encode="off"),
+                        make_params(), quad_loss, num_clients=16)
+    args = (rt_on.init_state(), ids, batch, mask,
+            jnp.asarray(0.1, jnp.float32), rt_on.cs)
+    assert (rt_on._round.lower(*args).as_text()
+            != rt_off._round.lower(*args).as_text())
+
+
+# ------------------------------------------------------------ decode overlap
+
+
+def test_decode_overlap_bitwise_vs_inline():
+    """The PR-5 gate pattern, server side: split cohort+decode rounds
+    are BIT-identical to the monolithic round — losses and weights."""
+    cfg_s = make_cfg(decode_overlap=True)
+    rt_s = FedRuntime(cfg_s, make_params(), quad_loss, num_clients=16)
+    ov = DecodeOverlapRound(rt_s)
+    rt_m = FedRuntime(make_cfg(), make_params(), quad_loss, num_clients=16)
+    ss, sm = rt_s.init_state(), rt_m.init_state()
+    batch, mask, ids = make_batch(1, W=W, B=B)
+    for r in range(4):
+        ss, mo = ov.round(ss, ids, batch, mask, 0.1)
+        sm, mi = rt_m.round(sm, ids, batch, mask, 0.1)
+        assert (np.asarray(mo["results"][0])
+                == np.asarray(mi["results"][0])).all(), r
+        assert (np.asarray(mo["n_valid"])
+                == np.asarray(mi["n_valid"])).all(), r
+    assert (np.asarray(ss.ps_weights) == np.asarray(sm.ps_weights)).all()
+
+
+def test_decode_overlap_metrics_contract():
+    """The adapter's metrics dict matches FedRuntime.round's contract
+    keys; signals is None (the split decouples what they compare)."""
+    cfg = make_cfg(decode_overlap=True)
+    rt = FedRuntime(cfg, make_params(), quad_loss, num_clients=16)
+    ov = DecodeOverlapRound(rt)
+    batch, mask, ids = make_batch(2, W=W, B=B)
+    _, m = ov.round(rt.init_state(), ids, batch, mask, 0.1)
+    rt_m = FedRuntime(make_cfg(signals=False), make_params(), quad_loss,
+                      num_clients=16)
+    _, mm = rt_m.round(rt_m.init_state(), ids, batch, mask, 0.1)
+    assert set(m) == set(mm), (sorted(m), sorted(mm))
+    assert m["signals"] is None
+    assert m["download_bytes"] is not None
+
+
+def test_decode_overlap_validation():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        make_cfg(decode_overlap=True, async_agg=True)
+    with pytest.raises(ValueError, match="--decode_overlap"):
+        validate_overlap_combo(make_cfg(
+            decode_overlap=True, mode="local_topk", error_type="local",
+            local_momentum=0.9, k=5))
+    # the adapter refuses a runtime built without the split executables
+    rt = FedRuntime(make_cfg(), make_params(), quad_loss, num_clients=16)
+    with pytest.raises(ValueError, match="decode_overlap"):
+        DecodeOverlapRound(rt)
+
+
+def test_decode_overlap_driver_end_to_end(tmp_path):
+    """The driver loop's --decode_overlap branch (cv_train.train):
+    one synthetic-CIFAR epoch split vs monolithic, identical data order
+    (same seed), train losses bit-identical — the PR-5 gate pattern at
+    driver granularity."""
+    from commefficient_tpu import cv_train, models
+    from commefficient_tpu.data import FedCIFAR10, transforms_for
+    from commefficient_tpu.losses import make_cv_loss
+
+    def run(decode_overlap):
+        ds = FedCIFAR10(str(tmp_path / f"d{int(decode_overlap)}"),
+                        synthetic=True, synthetic_per_class=8,
+                        transform=transforms_for("CIFAR10", True, seed=0))
+        cfg = FedConfig(mode="sketch", error_type="virtual", k=10,
+                        num_rows=2, num_cols=64, num_blocks=2,
+                        sketch_impl="hash", local_momentum=0.0,
+                        virtual_momentum=0.9, num_workers=4,
+                        local_batch_size=4, num_clients=ds.num_clients,
+                        num_epochs=1.0, track_bytes=True,
+                        compute_dtype="float32", telemetry=False,
+                        decode_overlap=decode_overlap)
+        model = models.ResNet9(num_classes=10,
+                               channels={"prep": 2, "layer1": 2,
+                                         "layer2": 2, "layer3": 2})
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.ones((1, 32, 32, 3)))
+        rt = FedRuntime(cfg, params, make_cv_loss(model, "float32"),
+                        num_clients=ds.num_clients)
+        state, summary = cv_train.train(cfg, rt, rt.init_state(), ds, ds)
+        return summary
+
+    s_split = run(True)
+    s_mono = run(False)
+    assert s_split is not None and np.isfinite(s_split["train_loss"])
+    assert s_split["train_loss"] == s_mono["train_loss"], (
+        s_split["train_loss"], s_mono["train_loss"])
+
+
+# ----------------------------------------------------- byte-count accounting
+
+
+def test_download_coord_counts_blocked_scan_matches_numpy():
+    """The blocked-scan byte accounting (which replaced the (W, d)
+    broadcast-compare-reduce — the fused round's largest temp buffer)
+    against the obvious numpy reference, incl. a d that does not divide
+    the block and thresholds the padding would satisfy if mis-padded."""
+    rt = FedRuntime(make_cfg(), make_params(), quad_loss, num_clients=16)
+    rng = np.random.RandomState(0)
+    for d in (100, 512 * 3 + 17, 2048):
+        clu = jnp.asarray(rng.randint(-1, 40, (d,)), jnp.int32)
+        # include the minimum threshold present in real states (0 after
+        # init, possibly -1-ish sentinels) — padding must never count
+        thr = jnp.asarray([0, 3, -1, 39], jnp.int32)
+        got = np.asarray(jax.jit(rt._download_coord_counts)(clu, thr))
+        ref = (np.asarray(clu)[None, :]
+               >= np.asarray(thr)[:, None]).sum(axis=1)
+        np.testing.assert_array_equal(got, ref, err_msg=str(d))
