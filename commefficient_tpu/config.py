@@ -502,6 +502,24 @@ class FedConfig:
     # per-client persistent rows, no topk_down) — unsound combos fail
     # fast. Mutually exclusive with --async_agg (which already splits).
     decode_overlap: bool = False
+    # Sharded sketch SERVER tail (core/server.py
+    # sharded_sketch_server_update): on a mesh, replace the round's
+    # replicated table psum with a psum_scatter over table columns
+    # (each device owns c/n columns of the momentum/EF state — the
+    # dense-mode reduce_scatter analogue), re-gather the small (r, c)
+    # error table, range-decode only the device's d_pad/n coordinate
+    # slice, take a local top-k and merge an (n, k)-sized candidate
+    # all-gather into the global top-k — no device ever materializes
+    # the dense (d,) decode estimates, so per-device server temp drops
+    # from O(d) to O(d/n + n*k):
+    # - "auto" (default): engage on an eligible mesh (table-state
+    #   sketch, no seq axis, num_cols divisible by the mesh size),
+    #   silently fall back to the replicated tail otherwise (the
+    #   fallback IS the pre-sharding round — numerics never change
+    #   silently);
+    # - "on": require it — fail fast listing every blocker;
+    # - "off": never (the replicated server tail, for A/B gates).
+    sketch_sharded_server: str = "auto"
     # jointly-computed round gradient (core/client.py make_fused_grad):
     # when no per-client nonlinearity exists, accumulate the round's
     # aggregate into ONE (d,) buffer instead of vmap's per-client (W, d)
@@ -544,6 +562,14 @@ class FedConfig:
                 f"{self.mode} has no sketch encode to fuse); drop the flag "
                 "or use --sketch_fused_encode auto (a no-op off sketch "
                 "mode)")
+        assert self.sketch_sharded_server in ("auto", "on", "off"), \
+            self.sketch_sharded_server
+        if self.sketch_sharded_server == "on" and self.mode != "sketch":
+            raise ValueError(
+                f"--sketch_sharded_server on requires --mode sketch (mode="
+                f"{self.mode} has no sketch server tail to shard); drop "
+                "the flag or use --sketch_sharded_server auto (a no-op "
+                "off sketch mode)")
         if self.decode_overlap and self.async_agg:
             raise ValueError(
                 "--decode_overlap and --async_agg are mutually exclusive: "
@@ -1042,6 +1068,14 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                         "while round t+1's client block is staged "
                         "(bit-identical losses; same soundness "
                         "constraints as --async_agg)")
+    p.add_argument("--sketch_sharded_server", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="shard the sketch server tail over the mesh "
+                        "(reduce-scattered table, shard-local range "
+                        "decode + candidate top-k merge; no device ever "
+                        "holds the dense (d,) estimates): auto = on an "
+                        "eligible mesh, on = require (fail fast "
+                        "otherwise), off = the replicated tail")
     p.add_argument("--sketch_dense_clip", action="store_true",
                    help="clip the dense worker gradient before sketch "
                         "encode (threshold x num_iters) instead of the "

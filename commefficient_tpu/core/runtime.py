@@ -34,6 +34,7 @@ from commefficient_tpu.config import FedConfig
 from commefficient_tpu.core import client as client_lib
 from commefficient_tpu.core.server import (robust_aggregate,
                                            server_update,
+                                           sharded_sketch_server_update,
                                            validate_defense_combo,
                                            validate_mode_combo,
                                            validate_regimes)
@@ -271,6 +272,70 @@ class FedRuntime:
                 "--sketch_server_state dense requires a single device "
                 "(no mesh) and deferred encode (no per-client table "
                 "clip — use --sketch_dense_clip to clip)")
+        # ---- sharded sketch SERVER tail (core/server.py
+        # sharded_sketch_server_update): replace the replicated table
+        # psum with a psum_scatter over table columns, run momentum+EF
+        # on the column shards, decode only this device's d_pad/n
+        # coordinate range, and merge an (n, k) candidate all-gather
+        # into the global top-k — no device ever materializes the dense
+        # (d,) estimates. Eligibility decided ONCE here (the
+        # fused-encode pattern): "auto" silently falls back to the
+        # replicated tail (the fallback IS the pre-sharding round —
+        # numerics never change silently), "on" fails fast listing
+        # every blocker.
+        ss_problems = []
+        if cfg.mode == "sketch":
+            if mesh is None:
+                ss_problems.append(
+                    "no mesh: there is nothing to shard the server tail "
+                    "over (the single-device round already holds the "
+                    "whole table)")
+            else:
+                if self._dense_preimage:
+                    ss_problems.append(
+                        "the dense-preimage server state has no table "
+                        "to reduce-scatter")
+                if self._seq_axis is not None:
+                    ss_problems.append(
+                        "a seq mesh axis: the table's column shards "
+                        "live on the clients axis only (the state's "
+                        "sketch_table layout), which a seq-sharded "
+                        "aggregation cannot feed without a reshard "
+                        "every round")
+                if (getattr(self.cs, "dense_transform", False)
+                        or not hasattr(self.cs, "decode_range")):
+                    ss_problems.append(
+                        f"sketch_impl={cfg.sketch_impl} has a dense "
+                        "transform (no cell-addressable table, no "
+                        "range-restricted decode, and an estimate-"
+                        "space EF rule); use circ or hash")
+                n_dev = mesh.shape[self._axis]
+                if cfg.num_cols % max(n_dev, 1) != 0:
+                    ss_problems.append(
+                        f"num_cols={cfg.num_cols} is not divisible by "
+                        f"the clients mesh axis ({n_dev} devices): the "
+                        "reduce-scattered column shards must tile "
+                        "evenly (pick --num_cols as a multiple of the "
+                        "device count; the circ auto-sizing's 1024-"
+                        "aligned widths already are for meshes up to "
+                        "1024 chips)")
+        self._sharded_server = (cfg.mode == "sketch"
+                                and cfg.sketch_sharded_server != "off"
+                                and not ss_problems)
+        if cfg.sketch_sharded_server == "on" and not self._sharded_server:
+            raise ValueError(
+                "--sketch_sharded_server on: the sharded server tail is "
+                "unavailable for this configuration (use auto to fall "
+                "back to the replicated tail instead):\n  "
+                + "\n  ".join(ss_problems))
+        # --decode_overlap composition: the table reduce itself MOVES
+        # into the decode executable — the cohort ends at each device's
+        # LOCAL partial table, so the round's metrics sync completes
+        # without waiting any ICI collective and the reduce-scatter +
+        # sharded decode both run while the host stages round t+1 (see
+        # _decode_step / _reduce_partials; bit-identity dryrun-gated).
+        self._reduce_in_decode = (self._sharded_server
+                                  and cfg.decode_overlap)
         # compression-signal health diagnostics (telemetry/signals.py):
         # cheap on-device reductions appended to the round's metrics.
         # Gated on telemetry too: with --no_telemetry nothing ever reads
@@ -764,6 +829,90 @@ class FedRuntime:
             body, jnp.zeros(thresholds.shape, jnp.int32), blocks)
         return counts
 
+    # ------------------------------------------------------- server dispatch
+
+    def _apply_server_update(self, state: FedState, agg: jax.Array,
+                             lr: jax.Array, server_rng: jax.Array, cs=None):
+        """Mode + topology dispatch of the server update rule — ONE
+        implementation consumed by the sync round step AND the split/
+        async server tails (the ``_transmit_tail`` discipline: the
+        sharded-vs-replicated parity gate rides on every path tracing
+        exactly the same ops). Returns ``(update, Vvel, Verr,
+        sup_mask)``; the sharded tail's update is a mesh-padded
+        (d_pad,) sharded vector, the replicated sketch decode's a
+        true-d one (the caller's padding block handles both)."""
+        cfg = self.cfg
+        server_lr = jnp.asarray(1.0) if cfg.mode == "fedavg" else lr
+        if (cfg.mode == "sketch" and not self._dense_preimage
+                and server_lr.ndim == 1 and not self._sharded_server):
+            # the replicated sketch branch multiplies lr against the
+            # TRUE-d decoded update (its state is the table, not a
+            # padded dense vector); the sharded tail multiplies
+            # per-shard against d_pad-length update shards, so there
+            # the vector stays mesh-padded (padding coords get
+            # multiplier 1 against an identically-zero update)
+            server_lr = server_lr[: cfg.grad_size]
+        if self._sharded_server:
+            update, Vvel, Verr = self._sharded_server_apply(
+                agg, state.Vvelocity, state.Verror, server_lr, cs)
+            return update, Vvel, Verr, None
+        return server_update(cfg, agg, state.Vvelocity, state.Verror,
+                             server_lr, cs=cs, dp_rng=server_rng,
+                             dense_preimage=self._dense_preimage)
+
+    def _sharded_server_apply(self, agg: jax.Array, Vvel_prev: jax.Array,
+                              Verr_prev: jax.Array, server_lr: jax.Array,
+                              cs=None):
+        """shard_map wrapper of core/server.sharded_sketch_server_update:
+        the reduce-scattered aggregate and the column-sharded
+        momentum/EF tables enter in the state's sketch_table layout
+        (P(None, clients) — no reshard), the update leaves as the
+        dense-vector layout's (d_pad,) coordinate shards (P(clients) —
+        matching ps_weights, so the weight apply runs sharded with no
+        further collective)."""
+        ax = self._axis
+        tab = P(None, ax)
+        n_dev = self.mesh.shape[ax]
+        lr_vec = server_lr.ndim == 1
+
+        def blk(agg, vvel, verr, lr, cs):
+            return sharded_sketch_server_update(
+                self.cfg, agg, vvel, verr, lr, cs, axis=ax,
+                n_shards=n_dev, d_pad=self.d_pad)
+
+        fn = shard_map(blk, mesh=self.mesh,
+                       in_specs=(tab, tab, tab,
+                                 P(ax) if lr_vec else P(),
+                                 jax.tree.map(lambda _: P(), cs)),
+                       out_specs=(P(ax), tab, tab), check_vma=False)
+        return fn(agg, Vvel_prev, Verr_prev, server_lr, cs)
+
+    def _reduce_partials(self, partials: jax.Array) -> jax.Array:
+        """--decode_overlap + sharded server: the cohort left each
+        device's LOCAL partial table stacked on the clients axis
+        ((n, r, c), device i owning slot i) — run the deferred
+        reduce-scatter here, in the DECODE executable, so the cohort's
+        metrics sync waits out no ICI collective and the reduce runs
+        under the next round's staging. Bitwise the sync round's
+        collective: same per-device partials, same op, same bf16 wire
+        rounding (the collective IS the wire)."""
+        ax = self._axis
+        td = self._table_dtype
+
+        def blk(part):
+            p = part[0]
+            if td != jnp.float32:
+                red = lax.optimization_barrier(lax.psum_scatter(
+                    p.astype(td), ax, scatter_dimension=1, tiled=True))
+                return red.astype(jnp.float32)
+            return lax.psum_scatter(p, ax, scatter_dimension=1,
+                                    tiled=True)
+
+        return shard_map(blk, mesh=self.mesh,
+                         in_specs=P(ax, None, None),
+                         out_specs=P(None, ax),
+                         check_vma=False)(partials)
+
     # ------------------------------------------------------------- round step
 
     def _round_step(self, state: FedState, client_ids: jax.Array,
@@ -946,6 +1095,27 @@ class FedRuntime:
                     agg = lax.psum_scatter(
                         jnp.pad(agg, (0, self.d_pad - cfg.grad_size)),
                         all_axes, scatter_dimension=0, tiled=True)
+                elif self._sharded_server:
+                    # sharded server tail: reduce-SCATTER over table
+                    # columns replaces the replicated table psum (the
+                    # dense-mode analogue above) — each device receives
+                    # only its c/n column shard of the summed table, the
+                    # (r, c) replicated result never exists, and the
+                    # momentum/EF tail runs on the shards
+                    # (core/server.sharded_sketch_server_update). The
+                    # --sketch_dtype bfloat16 wire covers this collective
+                    # exactly like the psum it replaces: the barrier pins
+                    # the payload dtype against XLA hoisting the f32
+                    # convert back through the reduce.
+                    if td != jnp.float32:
+                        agg = lax.optimization_barrier(lax.psum_scatter(
+                            agg.astype(td), self._axis,
+                            scatter_dimension=1, tiled=True))
+                        agg = agg.astype(jnp.float32)
+                    else:
+                        agg = lax.psum_scatter(agg, self._axis,
+                                               scatter_dimension=1,
+                                               tiled=True)
                 else:
                     # sketch tables are already the compressed payload: one
                     # table-sized psum (analogue of encode-before-NCCL);
@@ -1039,10 +1209,19 @@ class FedRuntime:
             )
             # dense modes leave the block as a reduce_scattered shard of
             # the summed gradient (over ALL axes); sketch leaves as a
-            # replicated (psum'd) table
+            # COLUMN-sharded reduce-scattered table under the sharded
+            # server tail (the state tables' home layout, so the tail
+            # consumes it in place), or a replicated (psum'd) table on
+            # the replicated path
             dense_agg_spec = P(tuple(self.mesh.axis_names))
+            if cfg.mode != "sketch":
+                agg_spec = dense_agg_spec
+            elif self._sharded_server:
+                agg_spec = P(None, ax)
+            else:
+                agg_spec = P()
             out_specs = (
-                dense_agg_spec if cfg.mode != "sketch" else P(),
+                agg_spec,
                 P(),
                 row_spec if (cfg.mode != "fedavg" and has_vel) else None,
                 row_spec if (cfg.mode != "fedavg" and has_err) else None,
@@ -1080,17 +1259,12 @@ class FedRuntime:
             # same normalization as agg: the signals compare like with like
             sig_dense = sig_dense / total
 
-        # ---- server update
-        server_lr = jnp.asarray(1.0) if cfg.mode == "fedavg" else lr
-        if (cfg.mode == "sketch" and not self._dense_preimage
-                and server_lr.ndim == 1):
-            # the sketch branch multiplies lr against the TRUE-d decoded
-            # update (its state is the table, not a padded dense vector)
-            server_lr = server_lr[: cfg.grad_size]
-        update, Vvel, Verr, sup_mask = server_update(
-            cfg, agg, state.Vvelocity, state.Verror, server_lr,
-            cs=cs, dp_rng=server_rng,
-            dense_preimage=self._dense_preimage)
+        # ---- server update (mode + topology dispatch: the sharded
+        # sketch tail on an eligible mesh, core/server.py's replicated
+        # rules otherwise — ONE implementation shared with the split/
+        # async server tails, see _apply_server_update)
+        update, Vvel, Verr, sup_mask = self._apply_server_update(
+            state, agg, lr, server_rng, cs)
 
         # ---- compression-signal health (telemetry/signals.py): on-device
         # scalars fetched asynchronously alongside the loss — computed
@@ -1376,6 +1550,28 @@ class FedRuntime:
                     agg = lax.psum_scatter(
                         jnp.pad(agg, (0, self.d_pad - cfg.grad_size)),
                         all_axes, scatter_dimension=0, tiled=True)
+                elif self._reduce_in_decode:
+                    # --decode_overlap + sharded server: the table
+                    # reduce MOVES into the decode executable — the
+                    # cohort ends at this device's LOCAL partial table
+                    # (stacked on the clients axis, zero wire traffic),
+                    # so the metrics sync completes without waiting any
+                    # ICI collective and the reduce-scatter runs under
+                    # round t+1's staging (see _reduce_partials; the
+                    # bf16 wire rounding travels WITH the collective)
+                    agg = agg[None]
+                elif self._sharded_server:
+                    # same reduce-scattered table collective as the
+                    # sync round's client block (bf16 barrier-pinned)
+                    if td != jnp.float32:
+                        agg = lax.optimization_barrier(lax.psum_scatter(
+                            agg.astype(td), self._axis,
+                            scatter_dimension=1, tiled=True))
+                        agg = agg.astype(jnp.float32)
+                    else:
+                        agg = lax.psum_scatter(agg, self._axis,
+                                               scatter_dimension=1,
+                                               tiled=True)
                 else:
                     if td != jnp.float32 and agg.ndim == 2:
                         agg = lax.optimization_barrier(
@@ -1402,8 +1598,17 @@ class FedRuntime:
                         P() if self._defense_ring else None,
                         jax.tree.map(lambda _: P(), cs))
             dense_agg_spec = P(tuple(self.mesh.axis_names))
+            if cfg.mode != "sketch":
+                agg_spec = dense_agg_spec
+            elif self._reduce_in_decode:
+                # stacked per-device partial tables (see client_block)
+                agg_spec = P(ax, None, None)
+            elif self._sharded_server:
+                agg_spec = P(None, ax)
+            else:
+                agg_spec = P()
             out_specs = (
-                dense_agg_spec if cfg.mode != "sketch" else P(),
+                agg_spec,
                 P(),
                 tuple(row for _ in range(cfg.num_results_train)),
                 row,
@@ -1507,14 +1712,8 @@ class FedRuntime:
         apart). Returns ``(replace_fields, update, Vvel, Verr)``; the
         caller owns ``rng`` advancement and any buffer handling."""
         cfg = self.cfg
-        server_lr = jnp.asarray(1.0) if cfg.mode == "fedavg" else lr
-        if (cfg.mode == "sketch" and not self._dense_preimage
-                and server_lr.ndim == 1):
-            server_lr = server_lr[: cfg.grad_size]
-        update, Vvel, Verr, _sup_mask = server_update(
-            cfg, agg, state.Vvelocity, state.Verror, server_lr,
-            cs=cs, dp_rng=server_rng,
-            dense_preimage=self._dense_preimage)
+        update, Vvel, Verr, _sup_mask = self._apply_server_update(
+            state, agg, lr, server_rng, cs)
 
         if self.d_pad != cfg.grad_size:
             if update.shape[0] == cfg.grad_size:
@@ -1586,6 +1785,11 @@ class FedRuntime:
         emitting them as executable outputs would force a (d,)-sized
         reduction per round that XLA cannot DCE."""
         rng, server_rng = jax.random.split(state.rng)
+        if self._reduce_in_decode:
+            # the cohort deferred the table reduce to THIS executable
+            # (stacked per-device partials): run the reduce-scatter
+            # first, then normalize — the sync round's exact order
+            cohort_sum = self._reduce_partials(cohort_sum)
         agg = cohort_sum / jnp.maximum(n_total, 1.0)
         fields, _update, _Vvel, _Verr = self._server_tail_fields(
             state, agg, lr, server_rng, cs)

@@ -33,7 +33,9 @@ import jax.numpy as jnp
 
 from commefficient_tpu.config import FedConfig
 from commefficient_tpu.ops import topk
-from commefficient_tpu.ops.topk import topk_with_idx
+from commefficient_tpu.ops.topk import (local_topk_candidates,
+                                        merge_topk_candidates,
+                                        topk_with_idx)
 
 # Measured divergence envelopes (round 5). local_topk with LOCAL error
 # feedback learns only with the LR cut far below the dense-stable value:
@@ -463,3 +465,109 @@ def server_update(
         return update * lr, Vvel, Verr, mask
 
     raise ValueError(f"unknown mode {cfg.mode}")
+
+
+def sharded_sketch_server_update(
+    cfg: FedConfig,
+    agg_shard: jax.Array,
+    Vvel_shard: jax.Array,
+    Verr_shard: jax.Array,
+    lr: jax.Array,
+    cs,
+    *,
+    axis: str,
+    n_shards: int,
+    d_pad: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The sketch-mode server tail, SHARDED — traced inside a
+    ``shard_map`` over ``axis`` (core/runtime.py wraps it; the
+    replicated twin is ``server_update``'s table branch, and the
+    sharded==replicated round-parity gate in ``dryrun_multichip`` pins
+    the two to the same numerics).
+
+    Per-shard view (device i of n): ``agg_shard``/``Vvel_shard``/
+    ``Verr_shard`` are (r, c/n) COLUMN shards of the datum-normalized
+    aggregate table and the momentum/EF state (the aggregate arrives
+    reduce-scattered — the client block's ``psum_scatter`` replaced the
+    replicated table psum). The tail:
+
+    1. momentum + virtual error, elementwise on the shards (table-space
+       linearity: column shards update independently);
+    2. ONE small (r, c)-sized all-gather of the error table (stacked
+       with the velocity table under the subtract-EF rule, which also
+       needs velocity estimates at the winners) — the table is the
+       compressed payload, gathering it is cheap by design;
+    3. shard-local range decode: device i decodes ONLY global
+       coordinates [i*d_pad/n, (i+1)*d_pad/n) (``cs.decode_range``;
+       coordinates >= d decode to exactly 0) — the dense (d,) estimate
+       vector NEVER materializes on any device, per-device temp drops
+       from O(d) to O(d/n);
+    4. local top-k candidates + an (n, k_loc)-sized candidate
+       all-gather + order-stable merge = the global top-k
+       (ops/topk.local_topk_candidates / merge_topk_candidates —
+       bitwise the unsharded selection, ties included);
+    5. error feedback re-encoded from the k sparse winners
+       (``encode_vals_at``, O(k*r) — every shard computes the tiny full
+       update table and keeps its column slice), zero-rule cell masking
+       or subtract-rule estimate subtraction exactly as the replicated
+       branch;
+    6. the update leaves as the device's dense (d_pad/n,) coordinate
+       shard — matching ``ps_weights``'s sharding, so the weight apply
+       runs fully sharded with no further collective.
+
+    ``lr`` is a replicated scalar or the device's (d_pad/n,) shard of
+    the per-parameter LR vector. Returns ``(update_shard, Vvel_shard',
+    Verr_shard')``.
+    """
+    from jax import lax
+
+    rho = cfg.virtual_momentum
+    Vvel = agg_shard + rho * Vvel_shard
+    Verr = Verr_shard + Vvel
+
+    if cfg.sketch_ef == "subtract":
+        full = lax.all_gather(jnp.stack([Verr, Vvel]), axis, axis=2,
+                              tiled=True)
+        Verr_full, Vvel_full = full[0], full[1]
+    else:
+        Verr_full = lax.all_gather(Verr, axis, axis=1, tiled=True)
+        Vvel_full = None
+
+    i = lax.axis_index(axis)
+    blk = d_pad // n_shards
+    start = i * blk
+    ests = cs.decode_range(Verr_full, start, blk)
+    loc_vals, loc_idx = local_topk_candidates(ests, cfg.k, start,
+                                              approx=cfg.approx_topk)
+    cand_v = lax.all_gather(loc_vals, axis)        # (n, k_loc) — the
+    cand_i = lax.all_gather(loc_idx, axis)         # ~n*k*8-byte payload
+    win_vals, win_idx = merge_topk_candidates(cand_v, cand_i, cfg.k)
+
+    # dense update SHARD: scatter the winners that land in my range
+    # (top-k indices are distinct, so set() is sound; out-of-range
+    # winners drop)
+    rel = win_idx - start
+    in_range = (rel >= 0) & (rel < blk)
+    update = jnp.zeros((blk,), jnp.float32).at[
+        jnp.where(in_range, rel, blk)].set(
+            jnp.where(in_range, win_vals, 0.0), mode="drop")
+
+    # error feedback from the k-sparse winners: the same re-encode the
+    # replicated branch does (encode_at(update, idx) ==
+    # encode_vals_at(vals, idx) by construction)
+    c_loc = Verr.shape[1]
+    sk_upd = cs.encode_vals_at(win_vals, win_idx)
+    sk_upd_sh = lax.dynamic_slice_in_dim(sk_upd, i * c_loc, c_loc, axis=1)
+    if cfg.sketch_ef == "subtract":
+        vel_ests = cs.decode_at(Vvel_full, win_idx)
+        sk_vel = cs.encode_vals_at(vel_ests, win_idx)
+        Vvel = Vvel - lax.dynamic_slice_in_dim(sk_vel, i * c_loc, c_loc,
+                                               axis=1)
+        Verr = Verr - sk_upd_sh
+    else:
+        mask = sk_upd_sh != 0
+        Vvel = jnp.where(mask, 0.0, Vvel)
+        Verr = jnp.where(mask, 0.0, Verr)
+    if cfg.error_decay < 1.0:
+        Verr = cfg.error_decay * Verr
+    return update * lr, Vvel, Verr

@@ -322,7 +322,17 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
         tracer = tracing.install()
         util = UtilizationTracker(telemetry, peak_flops=cfg.peak_flops,
                                   peak_hbm_gbps=cfg.peak_hbm_gbps,
-                                  watcher=telemetry.watcher())
+                                  watcher=telemetry.watcher(),
+                                  # schema v7: the round's mesh topology,
+                                  # so per-chip throughput normalizes
+                                  # from the stream alone
+                                  n_devices=(runtime.mesh.size
+                                             if runtime.mesh is not None
+                                             else 1),
+                                  mesh_shape=(list(runtime.mesh.shape
+                                                   .values())
+                                              if runtime.mesh is not None
+                                              else None))
         if model_flops_per_round:
             # analytic MFU numerator (gpt2_train passes one: XLA's cost
             # analysis under-counts scanned rounds, models/gpt2.py)

@@ -309,6 +309,42 @@ class CirculantSketch:
                         * table[j, self._buckets_of(j, idx)])
         return median_axis0(jnp.stack(ests))
 
+    def decode_range(self, table: jax.Array, start, length: int
+                     ) -> jax.Array:
+        """Median-of-r estimates of the ``length`` contiguous
+        coordinates starting at global index ``start``: equals
+        ``decode(table)[start:start+length]`` for coordinates < d, and
+        EXACTLY 0 beyond d (mesh padding must never win a top-k).
+
+        ``start`` may be a TRACED scalar (the sharded server tail's
+        ``axis_index``-dependent slice, core/server.py) — the static
+        per-block shifts cannot be selected at trace time then, so this
+        runs the ``decode_at`` gather form (the ONE shared bucket/sign
+        definition) chunk by chunk: peak memory O(r * chunk), no
+        (d,)-sized buffer. Same estimate values as the static-roll
+        decode — rolls and gathers move the same table cells.
+        """
+        assert table.shape == self.table_shape, (table.shape,
+                                                 self.table_shape)
+        assert length >= 1, length
+        start = jnp.asarray(start, jnp.int32)
+        bl = min(self.c, length)
+        nb = -(-length // bl)
+        base = jnp.arange(bl, dtype=jnp.int32)
+
+        def body(_, off):
+            idx = start + off + base          # (bl,) global coordinates
+            ests = jnp.stack([self._sign_of(j, idx)
+                              * table[j, self._buckets_of(j, idx)]
+                              for j in range(self.r)])
+            return None, jnp.where(idx < self.d, median_axis0(ests), 0.0)
+
+        if nb == 1:
+            return body(None, jnp.int32(0))[1][:length]
+        _, ests = jax.lax.scan(body, None,
+                               jnp.arange(nb, dtype=jnp.int32) * bl)
+        return ests.reshape(-1)[:length]
+
     def decode(self, table: jax.Array) -> jax.Array:
         assert table.shape == self.table_shape, (table.shape,
                                                  self.table_shape)

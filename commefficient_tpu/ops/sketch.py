@@ -106,6 +106,10 @@ class CountSketch:
     def decode_at(self, table: jax.Array, idx: jax.Array) -> jax.Array:
         return sketch_decode_at(self, table, idx)
 
+    def decode_range(self, table: jax.Array, start, length: int
+                     ) -> jax.Array:
+        return sketch_decode_range(self, table, start, length)
+
     def unsketch(self, table: jax.Array, k: int, approx: bool = False):
         return sketch_unsketch(self, table, k, approx=approx)
 
@@ -347,6 +351,41 @@ def sketch_decode_at(cs: CountSketch, table: jax.Array,
     buckets, signs = _buckets_signs(cs, idx.astype(_U32))
     rows = jnp.arange(cs.r)[:, None]
     return median_axis0(signs * table[rows, buckets])
+
+
+def sketch_decode_range(cs: CountSketch, table: jax.Array, start,
+                        length: int) -> jax.Array:
+    """Median-of-r estimates of the ``length`` contiguous coordinates
+    starting at global index ``start``: equals
+    ``sketch_decode(cs, table)[start:start+length]`` for coordinates
+    < d, and EXACTLY 0 beyond d (mesh-padding coordinates must never
+    win a top-k against real estimates).
+
+    ``start`` may be a python int or a TRACED scalar — the range
+    restriction the sharded server tail needs (each device decodes only
+    its ``axis_index``-dependent d_pad/n slice, core/server.py). The
+    bucket/sign maps are pure index arithmetic, so a traced offset
+    costs nothing; chunking via ``lax.scan`` bounds peak memory at
+    O(r * block_len) exactly like the full decode.
+    """
+    assert table.shape == cs.table_shape, (table.shape, cs.table_shape)
+    assert length >= 1, length
+    start = jnp.asarray(start, jnp.int32)
+    bl = min(cs.block_len, length)
+    nb = -(-length // bl)
+    rows = jnp.arange(cs.r)[:, None]
+    base = jnp.arange(bl, dtype=jnp.int32)
+
+    def body(_, off):
+        idx = start + off + base              # (bl,) global coordinates
+        buckets, signs = _buckets_signs(cs, idx.astype(_U32))
+        ests = median_axis0(signs * table[rows, buckets])
+        return None, jnp.where(idx < cs.d, ests, 0.0)
+
+    if nb == 1:
+        return body(None, jnp.int32(0))[1][:length]
+    _, ests = lax.scan(body, None, jnp.arange(nb, dtype=jnp.int32) * bl)
+    return ests.reshape(-1)[:length]
 
 
 def sketch_l2estimate(cs: CountSketch, table: jax.Array) -> jax.Array:

@@ -33,6 +33,65 @@ def topk_with_idx(vec: jax.Array, k: int, approx: bool = False):
     return jnp.zeros_like(vec).at[idx].set(vec[idx]), idx
 
 
+def local_topk_candidates(vec: jax.Array, k: int, offset=0,
+                          approx: bool = False):
+    """Per-shard candidate stage of a sharded global top-k.
+
+    ``vec`` is one shard's contiguous slice of the global vector,
+    starting at global coordinate ``offset`` (python int or traced
+    scalar — e.g. ``axis_index * shard_len`` inside a shard_map).
+    Returns the local top ``min(k, len)`` entries as ``(values, global
+    indices)``, sorted by descending squared magnitude with ties in
+    ascending index order (``lax.top_k`` is stable) — the ordering
+    contract ``merge_topk_candidates`` needs to reproduce the unsharded
+    selection exactly. ``approx`` uses the TPU bucketed approximate
+    top-k per shard (composing two approximations; recovery recall is
+    bounded below by the local kernel's target, same rationale as
+    ``topk_with_idx``).
+
+    Taking min(k, len) candidates is what makes the merge EXACT: the
+    global top-k has at most min(k, len) winners inside any one shard,
+    so every global winner is among its shard's candidates.
+    """
+    k_loc = min(int(k), vec.shape[0])
+    if approx:
+        _, li = lax.approx_max_k(vec * vec, k_loc, recall_target=0.95)
+    else:
+        _, li = lax.top_k(vec * vec, k_loc)
+    return vec[li], jnp.asarray(offset, jnp.int32) + li.astype(jnp.int32)
+
+
+def merge_topk_candidates(cand_vals: jax.Array, cand_idx: jax.Array,
+                          k: int):
+    """Merge per-shard top-k candidates into the global top-k.
+
+    ``cand_vals``/``cand_idx`` are ``(n_shards, k_loc)`` stacks from
+    ``local_topk_candidates`` over ``n_shards`` contiguous slices in
+    global index order (the shape a per-shard all-gather produces).
+    Returns ``(values, indices)`` — the exact sequence
+    ``topk_with_idx`` produces on the concatenated vector.
+
+    Order-stability: within a shard, equal-magnitude candidates appear
+    in ascending index order (stable local top-k); across shards, shard
+    order IS global index order (contiguous slices). So the flattened
+    candidate order is consistent with ascending global index among
+    equal magnitudes, and ``lax.top_k``'s first-occurrence tie-breaking
+    selects the same coordinates in the same order as the unsharded
+    top-k — including ties that straddle shard boundaries (pinned by
+    tests/test_sharded_server.py). Handles k not divisible by n_shards
+    (k_loc = min(k, shard_len), the merge just ranks n*k_loc
+    candidates) and k >= shard_len (every shard contributes its whole
+    slice and the merge degenerates to the exact unsharded top-k).
+    """
+    flat_v = cand_vals.reshape(-1)
+    flat_i = cand_idx.reshape(-1)
+    assert flat_v.shape[0] >= k, (
+        f"{cand_vals.shape} candidates cannot cover k={k}: each shard "
+        "must contribute min(k, shard_len) candidates")
+    _, sel = lax.top_k(flat_v * flat_v, k)
+    return flat_v[sel], flat_i[sel]
+
+
 def _topk_1d(vec: jax.Array, k: int, approx: bool = False) -> jax.Array:
     return topk_with_idx(vec, k, approx)[0]
 

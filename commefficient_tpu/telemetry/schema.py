@@ -20,18 +20,20 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterable, List, Tuple
 
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 # streams written by older code stay readable: v1 lacks the span /
 # utilization event types (added in v2), v2 lacks client_stats / alert
 # (added in v3), v3 lacks async_round (added in v4), v4 lacks defense
 # (added in v5), v5 lacks memory_ledger and the enriched memory /
 # utilization fields (added in v6 — the first version to ADD FIELDS to
 # existing event types; see FIELDS_SINCE_V6, which the validator only
-# requires of v6+ streams), but each is otherwise a subset of its
-# successor — so the validator accepts any supported manifest version.
-# A version it does not know is the error, not a version merely older
-# than current.
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, SCHEMA_VERSION)
+# requires of v6+ streams), v6 lacks the utilization mesh-topology
+# fields (n_devices / mesh_shape, added in v7 for the scaling-curve
+# harness — FIELDS_SINCE_V7, same vintage-gated requirement), but each
+# is otherwise a subset of its successor — so the validator accepts any
+# supported manifest version. A version it does not know is the error,
+# not a version merely older than current.
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, SCHEMA_VERSION)
 TELEMETRY_BASENAME = "telemetry.jsonl"
 
 
@@ -269,6 +271,13 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "achieved_gbps": _opt_num,    # bytes * rounds / wall_s, in GB/s
         "bw_frac": _opt_num,          # achieved_gbps / peak_hbm_gbps
         "expected_round_s": _opt_num,  # max(flops/peakF, bytes/peakBW)
+        # schema v7 (the scaling-curve harness): the window's mesh
+        # topology, so per-chip normalization (throughput/chip, the
+        # weak-scaling contract) is computable from the stream alone.
+        # n_devices is the device count the watched executable ran
+        # over; mesh_shape the mesh dims (null when no mesh)
+        "n_devices": _opt_num,
+        "mesh_shape": _opt_list,
     },
     # per-client population summary for one round (telemetry/clients.py):
     # on-device quantile reductions over the round's client axis (the
@@ -388,6 +397,12 @@ FIELDS_SINCE_V6: Dict[str, Tuple[str, ...]] = {
                     "achieved_gbps", "bw_frac", "expected_round_s"),
 }
 
+# fields ADDED in schema v7 (the scaling-curve mesh-topology fields) —
+# same vintage-gated requirement as FIELDS_SINCE_V6
+FIELDS_SINCE_V7: Dict[str, Tuple[str, ...]] = {
+    "utilization": ("n_devices", "mesh_shape"),
+}
+
 
 def validate_event(obj: Any,
                    version: int = SCHEMA_VERSION) -> List[str]:
@@ -412,9 +427,12 @@ def validate_event(obj: Any,
         problems.append(f"unknown event type {kind!r}")
         return problems
     v6_only = FIELDS_SINCE_V6.get(kind, ())
+    v7_only = FIELDS_SINCE_V7.get(kind, ())
     for field, pred in spec.items():
         if field not in obj:
             if version < 6 and field in v6_only:
+                continue
+            if version < 7 and field in v7_only:
                 continue
             problems.append(f"{kind}: missing field {field!r}")
         elif not pred(obj[field]):

@@ -183,18 +183,27 @@ def utilization_fields(*, rounds: int, wall_s: float,
                        spread: Optional[float] = None,
                        bytes_per_round: Optional[float] = None,
                        bytes_source: Optional[str] = None,
-                       peak_hbm_gbps: Optional[float] = None
+                       peak_hbm_gbps: Optional[float] = None,
+                       n_devices: Optional[int] = None,
+                       mesh_shape: Optional[List[int]] = None
                        ) -> Dict[str, Any]:
     """The pure MFU/starvation math, separated from event emission so
     tests can drive it with synthetic cost dicts and fake peak tables.
     Schema v6: joins the roofline attribution (roofline_fields) when a
-    byte count / bandwidth peak is supplied — null fields otherwise."""
+    byte count / bandwidth peak is supplied — null fields otherwise.
+    Schema v7: carries the window's mesh topology (``n_devices`` /
+    ``mesh_shape``) so per-chip throughput — the weak-scaling contract
+    scripts/scaling_curves.py gates — is computable from the stream
+    alone; null when the caller knows neither, never a fake 1."""
     achieved = mfu = None
     if flops_per_round and wall_s > 0:
         achieved = flops_per_round * rounds / wall_s
         if peak_flops:
             mfu = achieved / peak_flops
     return {
+        "n_devices": int(n_devices) if n_devices else None,
+        "mesh_shape": (list(int(x) for x in mesh_shape)
+                       if mesh_shape is not None else None),
         "rounds": int(rounds),
         "wall_s": round(wall_s, 6),
         "device_kind": device_kind,
@@ -228,11 +237,16 @@ def emit_from_totals(telemetry, *, rnd: int, rounds: int, wall_s: float,
                      per_host_device_s: Optional[List[float]] = None,
                      bytes_per_round: Optional[float] = None,
                      bytes_source: Optional[str] = None,
-                     peak_hbm_gbps: float = 0.0
+                     peak_hbm_gbps: float = 0.0,
+                     n_devices: Optional[int] = None,
+                     mesh_shape: Optional[List[int]] = None
                      ) -> Dict[str, Any]:
     """One-shot ``utilization`` event from aggregate totals (the bench
     path: one event per timed stage). Returns the computed fields so the
     caller can fold them into its JSON artifact too."""
+    if n_devices is None:
+        import jax
+        n_devices = len(jax.devices())
     fields = utilization_fields(
         rounds=rounds, wall_s=wall_s, host_s=host_s, dispatch_s=dispatch_s,
         device_s=device_s, flops_per_round=flops_per_round,
@@ -240,7 +254,8 @@ def emit_from_totals(telemetry, *, rnd: int, rounds: int, wall_s: float,
         peak_flops=peak_flops_for(device_kind, peak_flops),
         spread=straggler_spread(per_host_device_s or []),
         bytes_per_round=bytes_per_round, bytes_source=bytes_source,
-        peak_hbm_gbps=peak_hbm_for(device_kind, peak_hbm_gbps))
+        peak_hbm_gbps=peak_hbm_for(device_kind, peak_hbm_gbps),
+        n_devices=n_devices, mesh_shape=mesh_shape)
     if telemetry is not None:
         telemetry.event("utilization", round=int(rnd), **fields)
     return fields
@@ -263,15 +278,23 @@ class UtilizationTracker:
     def __init__(self, telemetry, *, device_kind: Optional[str] = None,
                  peak_flops: float = 0.0, watcher=None,
                  watch_name: str = "round_step",
-                 peak_hbm_gbps: float = 0.0):
+                 peak_hbm_gbps: float = 0.0,
+                 n_devices: Optional[int] = None,
+                 mesh_shape: Optional[List[int]] = None):
         self._telemetry = telemetry
         self._watcher = watcher
         self._watch_name = watch_name
-        if device_kind is None:
+        if device_kind is None or n_devices is None:
             import jax
             devices = jax.devices()
-            device_kind = (getattr(devices[0], "device_kind", "unknown")
-                           if devices else "none")
+            if device_kind is None:
+                device_kind = (getattr(devices[0], "device_kind",
+                                       "unknown")
+                               if devices else "none")
+            if n_devices is None:
+                n_devices = len(devices)
+        self.n_devices = n_devices
+        self.mesh_shape = mesh_shape
         self.device_kind = device_kind
         self.peak_flops = peak_flops_for(device_kind, peak_flops)
         if self.peak_flops is None:
@@ -354,7 +377,8 @@ class UtilizationTracker:
             device_kind=self.device_kind, peak_flops=self.peak_flops,
             spread=straggler_spread(self._per_host),
             bytes_per_round=nbytes, bytes_source=bsource,
-            peak_hbm_gbps=self.peak_hbm_gbps)
+            peak_hbm_gbps=self.peak_hbm_gbps,
+            n_devices=self.n_devices, mesh_shape=self.mesh_shape)
         self._telemetry.event("utilization", round=int(rnd), **fields)
         self._reset()
         return fields

@@ -60,6 +60,10 @@ _SAMPLE_OVERRIDES = {
     "achieved_gbps": 500.0,
     "bw_frac": 0.61,
     "expected_round_s": 0.0049,
+    # schema-v7 mesh-topology fields of the utilization event (the
+    # scaling-curve harness's per-chip normalization inputs)
+    "n_devices": 8,
+    "mesh_shape": [8],
     # schema-v6 residency enrichment of the memory event (a healthy
     # snapshot with headroom) — null on CPU streams, see memory_ledger
     "live_bytes": 9.0e9,

@@ -43,6 +43,13 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_memory.py -q \
 env JAX_PLATFORMS=cpu python -m pytest tests/test_fused_encode.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
+# sharded sketch server: a regression here (lost sharded==replicated
+# round parity, a drifting range decode or top-k merge, a table-sized
+# all-reduce sneaking back, broken eligibility fail-fasts, the teleview
+# per-chip gate) fails in seconds, before the full suite
+env JAX_PLATFORMS=cpu python -m pytest tests/test_sharded_server.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -130,6 +130,20 @@ def run_round() -> None:
             f"{counts.get(kind)} {kind} launches per round (bound "
             f"{limit}): a collective got unrolled — the round-5 per-row "
             "all_to_all regression class")
+    # sharded-server kinds (PR 11): the sketch round's table psum is a
+    # reduce-scatter now, and the shard-local top-k adds the ~n*k*8-byte
+    # candidate all-gathers — every process (ref AND workers) must
+    # compile them, and the launcher's dict cross-check below then
+    # verifies ref == workers over the NEW kinds exactly like the old
+    # ones. A sketch round with no reduce-scatter means the replicated
+    # tail silently came back.
+    assert counts.get("reduce-scatter", 0) >= 1, (
+        f"sketch round compiled without the reduce-scattered table "
+        f"aggregation (sharded server regressed): {counts}")
+    n_gathers = counts.get("all-gather", 0)
+    assert n_gathers >= 3, (
+        f"sketch round compiled only {n_gathers} all-gathers — the "
+        "sharded tail's table re-gather + candidate gathers are missing")
     print(f"COLLECTIVES {json.dumps(counts, sort_keys=True)}", flush=True)
 
     # replicate-reduce before fetching: ps_weights is mesh-sharded and a

@@ -62,7 +62,13 @@ chrome://tracing.
 - on schema-v6 streams, a watched executable's ``memory_ledger`` temp
   bytes growing beyond ``--temp_bytes_growth``x (the de-fusion /
   re-materialization regression class), or the final ``utilization``
-  ``bw_frac`` dropping more than ``--bw_frac_drop`` (absolute).
+  ``bw_frac`` dropping more than ``--bw_frac_drop`` (absolute);
+- PER-CHIP throughput (the weak-scaling contract,
+  scripts/scaling_curves.py): the last ``bench`` event carrying
+  ``result.per_chip_items_per_s`` dropping more than ``--perchip_drop``
+  (relative) against the baseline stream — on a weak-scaling sweep the
+  baseline is the smallest mesh's arm, so a sharding regression that
+  taxes every added chip fails the diff.
 
 Dependency-free (json + argparse), validates nothing itself — run
 ``scripts/check_telemetry_schema.py`` for schema enforcement.
@@ -718,6 +724,25 @@ def diff(a: List[Dict[str, Any]], b: List[Dict[str, Any]],
                 f"(drop > {args.bw_frac_drop:.2f} — achieved HBM "
                 "bandwidth regressed against the same peak)")
 
+    def per_chip(events):
+        # last bench event carrying the per-chip throughput (the
+        # scaling-curve arms emit it; ordinary runs have none and the
+        # gate is vacuous-by-absence, like every other diff gate)
+        for e in reversed(by_kind(events, "bench")):
+            v = _fin((e.get("result") or {}).get("per_chip_items_per_s"))
+            if v is not None:
+                return v
+        return None
+
+    pa, pb = per_chip(a), per_chip(b)
+    if pa is not None and pb is not None and pa > 0 \
+            and pb < pa * (1 - args.perchip_drop):
+        problems.append(
+            f"bench: per_chip_items_per_s {pa:.5g} -> {pb:.5g} "
+            f"(> {args.perchip_drop:.0%} relative drop — per-chip "
+            "throughput regressed; on a weak-scaling sweep this means "
+            "added chips are being taxed instead of adding capacity)")
+
     aa, ab = by_kind(a, "async_round"), by_kind(b, "async_round")
     if aa and ab:
         za = _fin(aa[-1].get("staleness_mean"))
@@ -853,6 +878,13 @@ def main(argv=None) -> int:
                    help="max ABSOLUTE drop of the final utilization "
                         "bw_frac (achieved HBM bandwidth as a fraction "
                         "of peak; schema-v6 streams)")
+    d.add_argument("--perchip_drop", type=float, default=0.30,
+                   help="fail if the last bench event's "
+                        "per_chip_items_per_s drops more than this "
+                        "relative fraction vs baseline (the weak-"
+                        "scaling gate; scripts/scaling_curves.py "
+                        "passes its own threshold for virtual-device "
+                        "dryruns)")
     d.add_argument("--clip_frac_rise", type=float, default=0.25,
                    help="max ABSOLUTE rise of the final defense "
                         "clip_frac (schema-v5 defense streams)")

@@ -414,6 +414,25 @@ def test_v5_stream_memory_event_stays_valid():
     assert any("bound" in p for p in validate_event(util, version=6))
 
 
+def test_v6_stream_utilization_stays_valid_without_v7_fields():
+    """FIELDS_SINCE_V7 compatibility: a v6 utilization event without
+    the mesh-topology fields (n_devices/mesh_shape) validates under
+    its own vintage but NOT under v7 — same contract as the v6
+    roofline fields one version earlier."""
+    from commefficient_tpu.telemetry.utilization import ROOFLINE_KEYS
+    util = {"event": "utilization", "t": 0.0, "seq": 2, "round": 1,
+            "rounds": 1, "wall_s": 1.0, "flops_per_round": None,
+            "flops_source": None, "device_kind": "cpu",
+            "peak_flops": None, "achieved_flops": None, "mfu": None,
+            "input_wait_frac": 0.0, "dispatch_frac": 0.0,
+            "device_wait_frac": 0.0, "straggler_spread": None,
+            **{k: None for k in ROOFLINE_KEYS}}
+    assert validate_event(util, version=6) == []
+    v7_problems = validate_event(util, version=7)
+    assert any("n_devices" in p for p in v7_problems)
+    assert any("mesh_shape" in p for p in v7_problems)
+
+
 # -------------------------------------------------- watcher integration
 
 

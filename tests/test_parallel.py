@@ -145,8 +145,9 @@ def _collective_shapes(rt, state, batch, mask, client_ids):
 ])
 def test_collectives_are_shard_or_table_sized(mode, extra):
     """The round's gradient aggregation must never be a replicated full-d
-    all-reduce: dense modes reduce_scatter (shard-sized payload per
-    device), sketch psums the (r, c) table (the compressed payload). The
+    all-reduce: dense modes reduce_scatter the d_pad/n gradient shard,
+    sketch reduce_scatters the (r, c) table over columns (the compressed
+    payload, sharded — PR 11's server tail). The
     only full-length collective allowed is the one all-gather every client
     needs to read the weights (reference: every worker reads g_ps_weights,
     fed_worker.py:41)."""
@@ -185,9 +186,16 @@ def test_collectives_are_shard_or_table_sized(mode, extra):
         elif n > 1:
             assert n <= bound, (kind, n)
         if kind == "reduce-scatter":
-            assert mode != "sketch" and n == d_pad // 8, (kind, n)
-    if mode != "sketch":
-        assert any(k == "reduce-scatter" for k, _ in colls), colls
+            if mode == "sketch":
+                # the sharded server tail (PR 11): the table aggregation
+                # reduce-scatters over COLUMNS — the result is the
+                # (r, c/8) shard, never the replicated table
+                assert n == table // 8, (kind, n)
+            else:
+                assert n == d_pad // 8, (kind, n)
+    # every mode reduce-scatters its aggregate now: dense modes the
+    # d_pad/n gradient shard, sketch the c/n table-column shard
+    assert any(k == "reduce-scatter" for k, _ in colls), colls
     if cfg.needs_client_velocities or cfg.needs_client_errors:
         assert any(k == "all-to-all" for k, _ in colls), colls
 
@@ -277,10 +285,14 @@ def test_bf16_sketch_tables():
     txt = rt16._round.lower(
         rt16.init_state(), cids, batch, mask,
         jnp.asarray(0.1, jnp.float32), rt16.cs).as_text()
+    # the sharded server tail (PR 11) reduce-SCATTERS the table over
+    # columns, so the bf16 wire now pins the scattered collective: the
+    # payload enters as the full bf16 table and leaves as the (r, c/8)
+    # bf16 column shard
     assert re.search(
-        r"stablehlo\.all_reduce.*?"
-        r"\(tensor<3x32xbf16>\) -> tensor<3x32xbf16>", txt, re.S), \
-        "expected a bf16 table-sized all_reduce in the lowering"
+        r"stablehlo\.reduce_scatter.*?"
+        r"\(tensor<3x32xbf16>\) -> tensor<3x4xbf16>", txt, re.S), \
+        "expected a bf16 table reduce_scatter in the lowering"
 
     # numerics: bf16 wire stays near the fp32 wire...
     rt32 = FedRuntime(make_cfg(**extra), params, quad_loss,
