@@ -16,19 +16,77 @@ across environments.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 import shutil
 import sys
 import tempfile
-from typing import Dict, Optional
+import zipfile
+from typing import Dict, List, Optional
 
 import jax
 import numpy as np
 
 from commefficient_tpu.core.state import FedState
+from commefficient_tpu.faults import maybe_fault
 
 _FIELDS = [f.name for f in dataclasses.fields(FedState)]
+
+
+class CheckpointIntegrityError(ValueError):
+    """A checkpoint FILE is unreadable or fails its content digests —
+    truncation, a bit flip, a kill mid-write. Distinct from the semantic
+    refusals (fingerprint/sketch-generation mismatch, live-state
+    truncation), which mean the CONFIG is wrong and no amount of
+    falling back through the rotation can fix it:
+    ``CheckpointManager.restore_latest`` catches exactly this class (and
+    only this class) to fall back generation-by-generation."""
+
+
+def _entry_digest(arr: np.ndarray) -> str:
+    """sha256 over (dtype, shape, raw bytes) of one stored array — the
+    per-entry integrity record ``meta.json`` carries under "digests"."""
+    arr = np.ascontiguousarray(arr)
+    h = hashlib.sha256()
+    h.update(str(arr.dtype).encode())
+    h.update(str(tuple(arr.shape)).encode())
+    h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _open_npz(path: str):
+    """np.load with every low-level failure (truncated zip, junk bytes,
+    bad magic) mapped to :class:`CheckpointIntegrityError` — the
+    fallback loop must be able to tell "this file is damaged" from
+    "this resume is misconfigured"."""
+    try:
+        return np.load(path + ".npz")
+    except Exception as e:
+        raise CheckpointIntegrityError(
+            f"checkpoint file {path}.npz is unreadable ({e})") from e
+
+
+def _read_entry(z, key: str, path: str,
+                digests: Optional[Dict[str, str]] = None) -> np.ndarray:
+    """Read one npz entry, mapping member-level corruption (bad CRC,
+    truncated stream) to CheckpointIntegrityError and verifying the
+    entry's sha256 when the meta sidecar recorded one."""
+    try:
+        arr = z[key]
+    except Exception as e:
+        raise CheckpointIntegrityError(
+            f"checkpoint file {path}.npz entry {key!r} is corrupt "
+            f"({e})") from e
+    if digests and key in digests:
+        got = _entry_digest(np.asarray(arr))
+        if got != digests[key]:
+            raise CheckpointIntegrityError(
+                f"checkpoint file {path}.npz entry {key!r} fails its "
+                f"sha256 digest (stored {digests[key][:12]}..., read "
+                f"{got[:12]}...): the data was corrupted after it was "
+                "written")
+    return arr
 
 
 def params_fingerprint(params) -> str:
@@ -54,48 +112,66 @@ DEFAULT_MAX_HOST_BYTES = int(os.environ.get(
 # (None is a meaningful value there: a non-sketch restoring run)
 _UNSET = object()
 
+# the file-damage classes restore_latest's generation fallback catches
+# (see CheckpointIntegrityError): our own integrity class plus the raw
+# zip/IO errors a member read can leak past the wrappers
+_DAMAGE_ERRORS = (CheckpointIntegrityError, zipfile.BadZipFile,
+                  OSError, EOFError, KeyError)
+
 
 def _state_nbytes(state: FedState) -> int:
     return sum(getattr(state, name).nbytes for name in _FIELDS
                if getattr(state, name) is not None)
 
 
-def _atomic_savez(path: str, arrays: Dict) -> None:
+def _atomic_savez(path: str, arrays: Dict) -> Dict[str, str]:
+    """Write atomically; returns the per-entry sha256 digests the meta
+    sidecar records for load-time integrity verification."""
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
                                suffix=".tmp")
     os.close(fd)
+    digests = {k: _entry_digest(np.asarray(v)) for k, v in arrays.items()}
     try:
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
+        # crash-matrix kill-point: tmp fully written, rename pending —
+        # a death here must leave the PREVIOUS generation intact and
+        # only .tmp litter behind (cleaned by CheckpointManager)
+        maybe_fault("mid_checkpoint_write")
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+    return digests
 
 
-def _atomic_savez_stream(path: str, entries) -> None:
+def _atomic_savez_stream(path: str, entries) -> Dict[str, str]:
     """Write an npz-compatible zip one array at a time. ``entries`` yields
     (key, thunk-returning-ndarray); each thunk's result is written to the
     archive and dropped before the next is produced, so peak host memory
     is ONE entry — the point of the sharded save (np.savez would require
     every shard of every field live in a dict simultaneously, i.e. the
-    full state the guard just refused to materialize)."""
-    import zipfile
+    full state the guard just refused to materialize). Returns per-entry
+    sha256 digests, like :func:`_atomic_savez`."""
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
                                suffix=".tmp")
     os.close(fd)
+    digests: Dict[str, str] = {}
     try:
         with zipfile.ZipFile(tmp, "w", zipfile.ZIP_STORED,
                              allowZip64=True) as zf:
             for key, thunk in entries:
                 arr = np.asarray(thunk())
+                digests[key] = _entry_digest(arr)
                 with zf.open(key + ".npy", "w", force_zip64=True) as f:
                     np.lib.format.write_array(f, arr, allow_pickle=False)
                 del arr
+        maybe_fault("mid_checkpoint_write")
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+    return digests
 
 
 def save_state(path: str, state: FedState, meta: Optional[Dict] = None,
@@ -159,7 +235,7 @@ def save_state(path: str, state: FedState, meta: Optional[Dict] = None,
                     "process (multi-host sharding). Per-host sharded "
                     "checkpointing is not supported — gather to one "
                     "process first or use a distributed checkpointer.")
-        _atomic_savez_stream(path + ".npz", entries)
+        digests = _atomic_savez_stream(path + ".npz", entries)
     else:
         total = _state_nbytes(state)
         if total > max_host_bytes:
@@ -175,9 +251,13 @@ def save_state(path: str, state: FedState, meta: Optional[Dict] = None,
             val = getattr(state, name)
             if val is not None:
                 arrays[name] = np.asarray(val)
-        _atomic_savez(path + ".npz", arrays)
+        digests = _atomic_savez(path + ".npz", arrays)
+    # per-entry sha256 digests ride the sidecar: load_state verifies
+    # them so a bit-flipped (CRC-evading) or partially-rewritten archive
+    # is caught as CheckpointIntegrityError instead of decoding garbage
+    meta = dict(meta or {}, digests=digests)
     with open(path + ".meta.json", "w") as f:
-        json.dump(meta or {}, f)
+        json.dump(meta, f)
     return path + ".npz"
 
 
@@ -197,7 +277,7 @@ def save_postmortem(path: str, state: FedState,
         meta["degraded"] = f"weights-only postmortem: {e}"
         print(f"WARNING: postmortem degraded to weights-only ({e})",
               file=sys.stderr)
-        _atomic_savez_stream(
+        digests = _atomic_savez_stream(
             path + ".npz",
             [("ps_weights__shape",
               lambda: np.asarray(state.ps_weights.shape, np.int64)),
@@ -209,7 +289,7 @@ def save_postmortem(path: str, state: FedState,
               lambda: np.zeros(1, np.int64)),
              ("__sharded__", lambda: np.asarray(1))])
         with open(path + ".meta.json", "w") as f:
-            json.dump(meta, f)
+            json.dump(dict(meta, digests=digests), f)
         return path + ".npz"
 
 
@@ -241,7 +321,9 @@ class _LayoutMismatch(Exception):
     pass
 
 
-def _try_streaming_restore(z, sharding) -> Optional[FedState]:
+def _try_streaming_restore(z, sharding, path: str = "",
+                           digests: Optional[Dict[str, str]] = None
+                           ) -> Optional[FedState]:
     """Same-topology restore of a sharded checkpoint WITHOUT ever
     materializing a full field on the host: each device shard is read
     from the archive and placed directly (host peak = one shard). Only
@@ -272,7 +354,7 @@ def _try_streaming_restore(z, sharding) -> Optional[FedState]:
             i = offmap.get(starts if shape else (0,))
             if i is None:
                 raise _LayoutMismatch(name)
-            arr = z[f"{name}__shard{i}"]
+            arr = _read_entry(z, f"{name}__shard{i}", path, digests)
             if tuple(arr.shape) != want:
                 raise _LayoutMismatch(name)
             return arr
@@ -284,24 +366,29 @@ def _try_streaming_restore(z, sharding) -> Optional[FedState]:
     return FedState(**fields)
 
 
-def _load_arrays(path: str) -> Dict[str, Optional[np.ndarray]]:
-    """Read either npz layout back into full per-field host arrays."""
-    with np.load(path + ".npz") as z:
+def _load_arrays(path: str, digests: Optional[Dict[str, str]] = None
+                 ) -> Dict[str, Optional[np.ndarray]]:
+    """Read either npz layout back into full per-field host arrays,
+    verifying per-entry digests when the meta sidecar recorded them."""
+    with _open_npz(path) as z:
         if "__sharded__" not in z.files:
-            return {name: (np.asarray(z[name]) if name in z.files else None)
+            return {name: (np.asarray(_read_entry(z, name, path, digests))
+                           if name in z.files else None)
                     for name in _FIELDS}
         kw: Dict[str, Optional[np.ndarray]] = {}
         for name in _FIELDS:
             if f"{name}__shape" not in z.files:
                 kw[name] = None
                 continue
-            shape = tuple(z[f"{name}__shape"])
-            out = np.empty(shape, dtype=str(z[f"{name}__dtype"]))
+            shape = tuple(_read_entry(z, f"{name}__shape", path, digests))
+            out = np.empty(shape, dtype=str(
+                _read_entry(z, f"{name}__dtype", path, digests)))
             i = 0
             covered = 0
             while f"{name}__shard{i}" in z.files:
-                shard = z[f"{name}__shard{i}"]
-                off = tuple(z[f"{name}__off{i}"])
+                shard = _read_entry(z, f"{name}__shard{i}", path, digests)
+                off = tuple(_read_entry(z, f"{name}__off{i}", path,
+                                        digests))
                 idx = tuple(slice(o, o + s)
                             for o, s in zip(off, shard.shape))
                 out[idx if shape else ...] = shard
@@ -320,7 +407,9 @@ def _load_arrays(path: str) -> Dict[str, Optional[np.ndarray]]:
 
 def load_state(path: str, sharding=None, d_pad: Optional[int] = None,
                num_clients: Optional[int] = None,
-               d_row_pad: Optional[int] = None) -> FedState:
+               d_row_pad: Optional[int] = None,
+               verify_digests: Optional[Dict[str, str]] = None
+               ) -> FedState:
     """Rebuild a FedState; optional sharding pytree (from
     ``FedRuntime._state_sharding``) places arrays sharded on load.
 
@@ -345,11 +434,12 @@ def load_state(path: str, sharding=None, d_pad: Optional[int] = None,
     = one shard, so states bigger than host RAM round-trip. Any shape
     migration falls back to host-side reassembly."""
     if sharding is not None:
-        with np.load(path + ".npz") as z:
+        with _open_npz(path) as z:
             if ("__sharded__" in z.files
                     and not _shapes_need_migration(z, d_pad, num_clients,
                                                    d_row_pad)):
-                state = _try_streaming_restore(z, sharding)
+                state = _try_streaming_restore(z, sharding, path,
+                                               verify_digests)
                 if state is not None:
                     # apply the same missing-field migration defaults as
                     # the host path below — the two restore paths must not
@@ -360,7 +450,7 @@ def load_state(path: str, sharding=None, d_pad: Optional[int] = None,
                             state, nan_round=jax.numpy.full((), -1,
                                                             jax.numpy.int32))
                     return state
-    kw = _load_arrays(path)
+    kw = _load_arrays(path, digests=verify_digests)
     if kw.get("nan_round") is None:
         kw["nan_round"] = np.full((), -1, np.int32)
     if d_pad is not None:
@@ -453,8 +543,11 @@ def load_meta(path: str) -> Dict:
 
 
 class CheckpointManager:
-    """Rotating checkpoints under ``directory``: ``ckpt_<epoch>``,
-    keeping the newest ``keep_last``."""
+    """Rotating checkpoints under ``directory``: ``ckpt_<epoch>`` at the
+    epoch cadence, plus out-of-cadence tagged generations
+    (``ckpt_<epoch>_r<round>_preempt`` — the graceful-preemption path
+    writes these mid-epoch). All generations share one rotation ordered
+    by ``(epoch, round_in_epoch)``, keeping the newest ``keep_last``."""
 
     def __init__(self, directory: str, keep_last: int = 3,
                  sharded: bool = False,
@@ -469,34 +562,97 @@ class CheckpointManager:
         # merged into every save's meta (drivers put the params fingerprint
         # here so resume can detect layout changes)
         self.default_meta: Dict = {}
+        # integrity fallbacks the LAST restore_latest performed, for the
+        # driver's `fault` telemetry events: [{"path", "error"}, ...]
+        self.restore_fallbacks: List[Dict[str, str]] = []
 
-    def _path(self, epoch: int) -> str:
-        return os.path.join(self.directory, f"ckpt_{epoch:06d}")
+    def _path(self, epoch: int, round_in_epoch: int = 0,
+              tag: Optional[str] = None) -> str:
+        stem = f"ckpt_{epoch:06d}"
+        if round_in_epoch or tag:
+            stem += f"_r{round_in_epoch:06d}_{tag or 'preempt'}"
+        return os.path.join(self.directory, stem)
+
+    def clean_stale_tmp(self) -> List[str]:
+        """Remove ``*.tmp`` litter a kill mid-write left behind (the
+        atomic writers unlink their tmp on every LIVE exit path, but
+        ``os._exit``/SIGKILL skips ``finally``). Called before every
+        save so the directory self-heals on the first post-crash
+        checkpoint; returns the removed paths."""
+        removed = []
+        if os.path.isdir(self.directory):
+            for fn in os.listdir(self.directory):
+                if fn.endswith(".tmp"):
+                    full = os.path.join(self.directory, fn)
+                    try:
+                        os.unlink(full)
+                        removed.append(full)
+                    except OSError:
+                        pass
+        if removed:
+            print(f"checkpoint: removed {len(removed)} stale .tmp "
+                  "file(s) from an interrupted write", file=sys.stderr)
+        return removed
 
     def save(self, state: FedState, epoch: int,
-             meta: Optional[Dict] = None) -> str:
-        meta = dict(self.default_meta, **(meta or {}), epoch=epoch)
-        out = save_state(self._path(epoch), state, meta,
-                         sharded=self.sharded,
+             meta: Optional[Dict] = None, round_in_epoch: int = 0,
+             tag: Optional[str] = None) -> str:
+        meta = dict(self.default_meta, **(meta or {}), epoch=epoch,
+                    round_in_epoch=int(round_in_epoch))
+        if tag:
+            meta["tag"] = tag
+        self.clean_stale_tmp()
+        out = save_state(self._path(epoch, round_in_epoch, tag), state,
+                         meta, sharded=self.sharded,
                          max_host_bytes=self.max_host_bytes)
         self._rotate()
         return out
 
     def _rotate(self) -> None:
-        for e in self.epochs()[: -self.keep_last]:
+        for _, _, stem in self.generations()[: -self.keep_last]:
             for suffix in (".npz", ".meta.json"):
-                fn = self._path(e) + suffix
+                fn = os.path.join(self.directory, stem) + suffix
                 if os.path.exists(fn):
                     os.unlink(fn)
 
-    def epochs(self):
+    @staticmethod
+    def _parse_stem(stem: str):
+        """``ckpt_EEEEEE[_rRRRRRR_tag]`` -> (epoch, round) or None."""
+        body = stem[len("ckpt_"):]
+        parts = body.split("_")
+        try:
+            epoch = int(parts[0])
+        except ValueError:
+            return None
+        rnd = 0
+        if len(parts) >= 2 and parts[1].startswith("r"):
+            try:
+                rnd = int(parts[1][1:])
+            except ValueError:
+                return None
+        return epoch, rnd
+
+    def generations(self):
+        """Every checkpoint generation as ``(epoch, round_in_epoch,
+        stem)``, sorted oldest -> newest. Epoch-cadence checkpoints sit
+        at round 0; a preempt checkpoint written r rounds into epoch e
+        sorts between the epoch-e and epoch-e+1 generations."""
         if not os.path.isdir(self.directory):
             return []
         out = []
         for fn in os.listdir(self.directory):
-            if fn.startswith("ckpt_") and fn.endswith(".npz"):
-                out.append(int(fn[len("ckpt_"):-len(".npz")]))
+            if not (fn.startswith("ckpt_") and fn.endswith(".npz")):
+                continue
+            stem = fn[: -len(".npz")]
+            key = self._parse_stem(stem)
+            if key is not None:
+                out.append((key[0], key[1], stem))
         return sorted(out)
+
+    def epochs(self):
+        """Epoch-cadence generations only (back-compat surface; the
+        rotation and restore walk :meth:`generations`)."""
+        return sorted(e for e, r, _ in self.generations() if r == 0)
 
     def latest(self) -> Optional[int]:
         es = self.epochs()
@@ -528,43 +684,107 @@ class CheckpointManager:
         --resume_unverified) downgrades SAME-layout marker mismatches to
         the caller's discard-and-continue path; cross-layout mismatches
         still raise (there is no state to discard INTO — the saved tables
-        and the runtime's pre-images do not even have the same shape)."""
-        e = self.latest()
-        if e is None:
+        and the runtime's pre-images do not even have the same shape).
+
+        Integrity fallback: a generation whose FILE is damaged — a
+        truncated zip, a bit flip caught by the per-entry sha256
+        digests, an unreadable meta sidecar — is skipped with a loud
+        warning and the restore falls back to the PREVIOUS generation
+        in the rotation (``restore_fallbacks`` records each skip for
+        the driver's `fault` telemetry). Semantic refusals above still
+        raise: a wrong config cannot be fixed by an older file. Only
+        when EVERY generation is damaged does the restore raise the
+        last integrity error — silently restarting a --resume run from
+        scratch would be worse than stopping."""
+        self.restore_fallbacks = []
+        gens = self.generations()
+        if not gens:
             return None, {}
-        meta = load_meta(self._path(e))
-        if expect_sketch_gen is not _UNSET and expect_sketch_gen is not None:
-            self._check_sketch_gen(meta.get("sketch_gen"),
-                                   expect_sketch_gen, sketch_mismatch_ok,
-                                   self._path(e))
-        if expect_async_gen is not _UNSET and expect_async_gen is not None:
-            # async-aggregation vintage, checked against the META before
-            # any state is materialized (the sketch_gen pattern): an
-            # async run resuming a checkpoint that carries no async
-            # ledger cannot verify the buffer/commit bookkeeping it is
-            # about to continue
-            self._check_async_gen(meta.get("async_gen"), expect_async_gen,
-                                  async_mismatch_ok, self._path(e))
-        saved_fp = meta.get("params_fingerprint")
-        if expect_fingerprint is not None:
-            if saved_fp is None and not allow_missing_fingerprint:
-                raise ValueError(
-                    f"checkpoint {self._path(e)} carries no params "
-                    "fingerprint (written by an older version), so its flat "
-                    "ps_weights layout cannot be verified against the "
-                    "current model. Pass allow_missing_fingerprint=True "
-                    "(drivers: --resume_unverified) only if the model "
-                    "configuration is unchanged since it was written.")
-            if saved_fp is not None and saved_fp != expect_fingerprint:
-                raise ValueError(
-                    f"checkpoint {self._path(e)} was written under a "
-                    f"different parameter layout (fingerprint {saved_fp} != "
-                    f"{expect_fingerprint}); the flat ps_weights vector "
-                    "would unravel into the wrong weights. Re-create the "
-                    "run or load with the original model configuration.")
-        return load_state(self._path(e), sharding=sharding, d_pad=d_pad,
-                          num_clients=num_clients,
-                          d_row_pad=d_row_pad), meta
+        last_err: Optional[Exception] = None
+        for _, _, stem in reversed(gens):
+            path = os.path.join(self.directory, stem)
+            try:
+                meta = self._load_meta_checked(path)
+            except CheckpointIntegrityError as err:
+                self._record_fallback(path, err)
+                last_err = err
+                continue
+            # semantic guards: checked against the META before any state
+            # is materialized, and NEVER downgraded to a fallback — a
+            # config mismatch is the same in every generation
+            if expect_sketch_gen is not _UNSET \
+                    and expect_sketch_gen is not None:
+                self._check_sketch_gen(meta.get("sketch_gen"),
+                                       expect_sketch_gen,
+                                       sketch_mismatch_ok, path)
+            if expect_async_gen is not _UNSET \
+                    and expect_async_gen is not None:
+                # async-aggregation vintage, checked against the META
+                # before any state is materialized (the sketch_gen
+                # pattern): an async run resuming a checkpoint that
+                # carries no async ledger cannot verify the
+                # buffer/commit bookkeeping it is about to continue
+                self._check_async_gen(meta.get("async_gen"),
+                                      expect_async_gen,
+                                      async_mismatch_ok, path)
+            saved_fp = meta.get("params_fingerprint")
+            if expect_fingerprint is not None:
+                if saved_fp is None and not allow_missing_fingerprint:
+                    raise ValueError(
+                        f"checkpoint {path} carries no params "
+                        "fingerprint (written by an older version), so "
+                        "its flat ps_weights layout cannot be verified "
+                        "against the current model. Pass "
+                        "allow_missing_fingerprint=True (drivers: "
+                        "--resume_unverified) only if the model "
+                        "configuration is unchanged since it was "
+                        "written.")
+                if saved_fp is not None and saved_fp != expect_fingerprint:
+                    raise ValueError(
+                        f"checkpoint {path} was written under a "
+                        f"different parameter layout (fingerprint "
+                        f"{saved_fp} != {expect_fingerprint}); the flat "
+                        "ps_weights vector would unravel into the wrong "
+                        "weights. Re-create the run or load with the "
+                        "original model configuration.")
+            try:
+                state = load_state(path, sharding=sharding, d_pad=d_pad,
+                                   num_clients=num_clients,
+                                   d_row_pad=d_row_pad,
+                                   verify_digests=meta.get("digests"))
+            except _DAMAGE_ERRORS as err:
+                # CheckpointIntegrityError plus the raw zip/IO classes a
+                # member-level read can still leak (e.g. a corrupt
+                # __shape entry inspected by the migration probe) —
+                # never the semantic ValueErrors, which propagate above
+                self._record_fallback(path, err)
+                last_err = err
+                continue
+            return state, meta
+        assert last_err is not None
+        raise CheckpointIntegrityError(
+            f"every checkpoint generation under {self.directory} is "
+            f"damaged ({len(self.restore_fallbacks)} tried); refusing "
+            "to silently restart from scratch. Last error: "
+            f"{last_err}")
+
+    @staticmethod
+    def _load_meta_checked(path: str) -> Dict:
+        """load_meta with sidecar corruption mapped to the integrity
+        class, so a meta.json truncated by the same crash that damaged
+        the npz also falls back instead of crashing the resume."""
+        try:
+            return load_meta(path)
+        except (OSError, ValueError) as e:
+            raise CheckpointIntegrityError(
+                f"checkpoint file {path}.meta.json is unreadable "
+                f"({e})") from e
+
+    def _record_fallback(self, path: str, err: Exception) -> None:
+        self.restore_fallbacks.append({"path": path, "error": str(err)})
+        print(f"WARNING: checkpoint {path} is unreadable or corrupt "
+              f"({err}); falling back to the previous generation in "
+              "the rotation", file=sys.stderr)
 
     @staticmethod
     def _check_sketch_gen(saved_gen, expect_gen: str, mismatch_ok: bool,

@@ -440,6 +440,37 @@ class FedConfig:
     nonfinite_action: str = "abort"
     quarantine_backoff: int = 8
     quarantine_strikes: int = 3
+    # --- preemption / fault tolerance (core/preempt.py) ---
+    # graceful-preemption drain budget, seconds: on SIGTERM/SIGINT the
+    # driver loop finishes the in-flight round, drains the input
+    # pipeline / async pool (flushing any open buffer through the
+    # epoch-flush path), writes an out-of-cadence checkpoint tagged
+    # `preempt` (round-granular meta, so the resume is exact), emits a
+    # final `fault` telemetry event and exits 0 — all within this
+    # budget. A SECOND signal force-exits immediately. Must be > 0.
+    preempt_grace: float = 30.0
+    # host-side hang watchdog (core/preempt.RoundWatchdog): arms a
+    # deadline around each round's dispatch+sync, derived from the
+    # rolling median round time (MAD-floored like the health.py rules)
+    # x watchdog_mult. On expiry it fires a critical `round_stall`
+    # alert through the AnomalyMonitor and records an events-only
+    # flight-recorder bundle (the state fetch itself could hang). Also
+    # arms bounded exponential-backoff RETRIES around the retryable
+    # host-side input phases (device_put / gather dispatch). Off by
+    # default: it adds a thread and retry semantics the lockstep tests
+    # must opt into.
+    watchdog: bool = False
+    # stall deadline = watchdog_mult x (rolling median + MAD envelope);
+    # must be >= 1 (a sub-1 multiplier would declare the MEDIAN round
+    # stalled)
+    watchdog_mult: float = 10.0
+    # fixed run directory for telemetry/tensorboard artifacts; empty =
+    # the timestamped make_logdir default. A resumed run pointed at its
+    # predecessor's logdir APPENDS to the existing events.jsonl with a
+    # `resume` lineage record (telemetry/run.py) instead of clobbering
+    # it.
+    logdir: str = ""
+
     # rematerialize transformer blocks on backward (memory/FLOPs trade)
     do_remat: bool = False
     # selective-remat policy (jax.checkpoint_policies attribute name, e.g.
@@ -668,6 +699,33 @@ class FedConfig:
             raise ValueError(
                 f"--quarantine_strikes {self.quarantine_strikes} must be "
                 ">= 1 (strikes before permanent ejection)")
+        # preemption / watchdog numerics (validated unconditionally, the
+        # scenario/defense-validator pattern: a bad value must fail at
+        # parse time, not when the first SIGTERM arrives)
+        if self.preempt_grace <= 0:
+            raise ValueError(
+                f"--preempt_grace {self.preempt_grace} must be > 0 "
+                "seconds (the graceful-drain budget after the first "
+                "SIGTERM/SIGINT; a second signal always force-exits)")
+        if self.watchdog_mult < 1:
+            raise ValueError(
+                f"--watchdog_mult {self.watchdog_mult} must be >= 1: the "
+                "stall deadline is this multiple of the rolling median "
+                "round time, and a sub-1 multiplier would declare the "
+                "median round stalled")
+        if self.watchdog and (not self.telemetry
+                              or self.telemetry_every == 0):
+            # the deadline history only fills on synced (record) rounds
+            # and the stall alert lands in the stream: without telemetry
+            # (or with records disabled) the watchdog would silently
+            # never arm — the exact silently-ignored-flag failure this
+            # repo fails fast on
+            raise ValueError(
+                "--watchdog requires telemetry round records to arm "
+                "(its deadline history fills on synced record rounds "
+                "and its round_stall alert goes to the stream): drop "
+                "--no_telemetry / set --telemetry_every != 0, or drop "
+                "--watchdog.")
         if self.profile_dir:
             # a bad window spec must fail at startup, not at round START
             from commefficient_tpu.telemetry.profiling import \
@@ -1046,6 +1104,29 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                    help="rounds a struck client sits out before a retry")
     p.add_argument("--quarantine_strikes", type=int, default=3,
                    help="strikes before permanent ejection")
+    p.add_argument("--preempt_grace", type=float, default=30.0,
+                   help="graceful-preemption drain budget in seconds: "
+                        "on SIGTERM/SIGINT, drain the pipeline/async "
+                        "pool, write a `preempt`-tagged checkpoint "
+                        "(round-granular meta) and exit 0 within this "
+                        "budget; a second signal force-exits")
+    p.add_argument("--watchdog", action="store_true",
+                   help="arm the hang watchdog: a host thread deadlines "
+                        "each round at --watchdog_mult x the rolling "
+                        "median round time, fires a critical "
+                        "round_stall alert + events-only flight-"
+                        "recorder bundle on expiry, and wraps the "
+                        "retryable input phases (device_put/gather "
+                        "dispatch) in bounded exponential-backoff "
+                        "retries")
+    p.add_argument("--watchdog_mult", type=float, default=10.0,
+                   help="stall deadline multiplier over the rolling "
+                        "median round time (>= 1)")
+    p.add_argument("--logdir", type=str, default="",
+                   help="fixed run directory for telemetry/tensorboard "
+                        "(empty = timestamped); a resumed run pointed "
+                        "at its predecessor's logdir APPENDS to the "
+                        "telemetry stream with a resume lineage record")
     p.add_argument("--remat", action="store_true", dest="do_remat")
     p.add_argument("--remat_policy", type=str, default="")
     p.add_argument("--lm_chunk", type=int, default=0)

@@ -7,8 +7,14 @@ from commefficient_tpu.core.async_agg import (AsyncAggregator,
                                               staleness_weight,
                                               validate_async_combo,
                                               validate_overlap_combo)
+from commefficient_tpu.core.preempt import (PreemptGuard, RoundWatchdog,
+                                            collect_ledger_state,
+                                            restore_ledger_state,
+                                            with_retries)
 
 __all__ = ["server_update", "validate_mode_combo", "FedState", "FedRuntime",
            "RoundInput", "RoundPipeline", "DecodeOverlapRound",
            "AsyncAggregator", "staleness_weight",
-           "validate_async_combo", "validate_overlap_combo"]
+           "validate_async_combo", "validate_overlap_combo",
+           "PreemptGuard", "RoundWatchdog", "with_retries",
+           "collect_ledger_state", "restore_ledger_state"]

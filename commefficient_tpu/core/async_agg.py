@@ -80,6 +80,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from commefficient_tpu.config import FedConfig
+from commefficient_tpu.faults import maybe_fault
 
 DISCOUNT_RULES = ("none", "poly", "exp")
 
@@ -363,6 +364,11 @@ class AsyncAggregator:
         eff_mask = fate.mask if fate is not None else mask_np
         state, payload = self.runtime.cohort(
             state, rnd.client_ids, batch, eff_mask, lr)
+        # crash-matrix kill-point: the pool holds in-flight cohorts and
+        # this tick's dispatch just happened — a death here must resume
+        # bit-identically (the epoch replays; the buffer was never
+        # checkpointed open, see reconcile_resumed_state)
+        maybe_fault("async_pool", tick)
         self.dispatched += 1
         latency = float(fate.latency) if fate is not None else 0.0
         bisect.insort(self._inflight,

@@ -102,15 +102,27 @@ class RoundPipeline:
         in flight to the device, one staged behind it.
     enabled : False = inline fetch on the caller's thread (identical
         outputs, zero threads — the ``--no_pipeline`` path).
+    skip : consume (but never fetch) the first ``skip`` sampler rounds —
+        the round-granular resume path: a run checkpointed ``skip``
+        rounds into an epoch rebuilds the SAME ``(seed, epoch)`` sampler
+        and fast-forwards past the rounds it already trained. The
+        sampler's RandomState draws replay identically (it is iterated
+        in order either way) and index-keyed fetch randomness is
+        untouched, so the first yielded round is bit-identical to what
+        the uninterrupted run would have trained next. Counted against
+        ``max_rounds`` (the cap is the epoch's ABSOLUTE round index).
     """
 
     def __init__(self, rounds: Iterable, fetch: Callable[[Any, int], Any],
                  *, start_round: int, max_rounds: Optional[int] = None,
-                 depth: int = 2, enabled: bool = True):
+                 depth: int = 2, enabled: bool = True, skip: int = 0):
         self._rounds = iter(rounds)
         self._fetch = fetch
         self._start = int(start_round)
         self._max = max_rounds if max_rounds is None else int(max_rounds)
+        if skip < 0:
+            raise ValueError(f"skip must be >= 0, got {skip}")
+        self._skip = int(skip)
         if enabled and depth < 1:
             # this used to silently degrade to the inline fetch — a
             # caller asking for prefetch got none and no message. The
@@ -161,7 +173,9 @@ class RoundPipeline:
         for i, rnd in enumerate(self._rounds):
             if self._max is not None and i >= self._max:
                 return
-            g = self._start + i + 1
+            if i < self._skip:
+                continue          # already-trained round: advance the
+            g = self._start + i + 1  # sampler, fetch nothing
             t0 = time.perf_counter()
             with tracing.span("data_fetch"):
                 batch = self._fetch(rnd, g)
@@ -179,6 +193,8 @@ class RoundPipeline:
                     break
                 if self._stop.is_set():
                     return
+                if i < self._skip:
+                    continue      # resume fast-forward (see class doc)
                 g = self._start + i + 1
                 t0 = time.perf_counter()
                 with tracing.span("data_fetch"):

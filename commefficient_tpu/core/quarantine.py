@@ -100,3 +100,26 @@ class QuarantineLedger:
             "ejected": len(self.ejected),
             "quarantine_ids_digest": self.ids_digest(rnd),
         }
+
+    # -------------------------------------------------------- persistence
+
+    def state_dict(self) -> Dict[str, Any]:
+        """JSON-serializable full state, carried in checkpoint meta so a
+        resumed run keeps its bench/eject decisions — without this, a
+        restart silently RE-ADMITS every benched and permanently-ejected
+        client until they strike all over again (keys stringified for
+        JSON; ``load_state_dict`` restores the int keys)."""
+        return {
+            "strikes": {str(c): n for c, n in self.strikes.items()},
+            "until": {str(c): u for c, u in self._until.items()},
+            "ejected": sorted(self.ejected),
+            "total_strikes": self.total_strikes,
+        }
+
+    def load_state_dict(self, d: Dict[str, Any]) -> None:
+        self.strikes = {int(c): int(n)
+                        for c, n in (d.get("strikes") or {}).items()}
+        self._until = {int(c): int(u)
+                       for c, u in (d.get("until") or {}).items()}
+        self.ejected = {int(c) for c in d.get("ejected") or ()}
+        self.total_strikes = int(d.get("total_strikes", 0))

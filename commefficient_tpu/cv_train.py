@@ -34,6 +34,7 @@ from commefficient_tpu.data import (
 )
 from commefficient_tpu.data.device_store import make_device_store
 from commefficient_tpu.data.fed_sampler import mask_blocked
+from commefficient_tpu.faults import maybe_fault
 from commefficient_tpu.losses import make_cv_loss
 from commefficient_tpu.telemetry import (ProfilerWindow, UtilizationTracker,
                                          signals_to_host, tracing)
@@ -97,9 +98,17 @@ def build_mesh(cfg: FedConfig):
 
 def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
     """Shared --checkpoint/--checkpoint_every/--resume wiring.
-    Returns (ckpt_mgr_or_None, start_epoch, restored_state_or_None)."""
+    Returns (ckpt_mgr_or_None, start_epoch, restored_state_or_None,
+    resume_info). ``resume_info`` is None for a fresh start; on resume
+    it carries the round-granular position plus everything the epoch
+    loop needs to continue EXACTLY — {"round_in_epoch": rounds already
+    trained in start_epoch (0 for epoch-cadence checkpoints),
+    "global_round", "ledgers": the host-ledger sidecar
+    (core/preempt.collect_ledger_state), "checkpoint": the restored
+    generation, "fallbacks": integrity fallbacks the restore performed
+    (for `fault` telemetry)}."""
     if not (cfg.do_checkpoint or cfg.do_resume or cfg.checkpoint_every):
-        return None, 0, None
+        return None, 0, None, None
     # use the runtime's RESOLVED config from here on: num_cols may have
     # been auto-sized at runtime init (config.auto_num_cols), and the
     # sketch-generation marker below must describe the tables actually
@@ -215,9 +224,25 @@ def setup_checkpointing(cfg: FedConfig, runtime: FedRuntime, name: str):
             for m in async_msgs:
                 print(f"WARNING: {m}", file=sys.stderr)
             start = int(meta.get("epoch", 0))
-            print(f"resumed from epoch {start}")
-            return mgr, start, restored
-    return mgr, 0, None
+            # round-granular position (schema: CheckpointManager.save) —
+            # epoch-cadence checkpoints sit at round 0, a preempt-tagged
+            # generation mid-epoch carries the rounds already trained so
+            # the epoch loop can rebuild the SAME (seed, epoch) sampler
+            # and skip exactly that many rounds (RoundPipeline skip=)
+            start_round = int(meta.get("round_in_epoch", 0))
+            resume_info = {
+                "round_in_epoch": start_round,
+                "global_round": int(meta.get("global_round", -1)),
+                "ledgers": meta.get("ledgers"),
+                "checkpoint": mgr._path(start, start_round,
+                                        meta.get("tag")),
+                "fallbacks": list(mgr.restore_fallbacks),
+            }
+            print(f"resumed from epoch {start}"
+                  + (f" + {start_round} rounds (preempt checkpoint)"
+                     if start_round else ""))
+            return mgr, start, restored, resume_info
+    return mgr, 0, None, None
 
 
 def build_datasets(cfg: FedConfig):
@@ -306,8 +331,12 @@ def make_writer(cfg: FedConfig, logdir: Optional[str] = None):
 def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
           lr_mult: Optional[jax.Array] = None, loggers=(), timer=None,
           ckpt_mgr=None, start_epoch: int = 0, writer=None, schedule=None,
-          telemetry=None, model_flops_per_round: Optional[float] = None):
+          telemetry=None, model_flops_per_round: Optional[float] = None,
+          resume_info=None):
     timer = timer or Timer()
+    # rounds already trained inside start_epoch (round-granular resume:
+    # a preempt-tagged checkpoint written mid-epoch; 0 everywhere else)
+    start_round = int((resume_info or {}).get("round_in_epoch", 0))
     # profiler window over --profile_rounds (telemetry/profiling.py);
     # replaces the window previously hardcoded to rounds 2-4 of this
     # driver only
@@ -392,6 +421,63 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
         from commefficient_tpu.core.quarantine import QuarantineLedger
         qledger = QuarantineLedger(backoff=cfg.quarantine_backoff,
                                    strikes=cfg.quarantine_strikes)
+    # ---- preemption / fault-tolerance layer (core/preempt.py) ----
+    # restore the host-ledger sidecar a round-granular checkpoint
+    # carried: quarantine strikes/benches/ejections (a restart must NOT
+    # re-admit known-bad clients), participation coverage, and the
+    # anomaly monitor's rolling histories — then announce the resume
+    # lineage (and any corrupt-generation fallbacks) into the stream
+    from commefficient_tpu.core.preempt import (PreemptGuard,
+                                                RoundWatchdog,
+                                                collect_ledger_state,
+                                                restore_ledger_state,
+                                                with_retries)
+    if resume_info is not None:
+        restore_ledger_state(resume_info.get("ledgers"), qledger=qledger,
+                             participation=ledger, monitor=monitor)
+        if telemetry is not None:
+            for fb in resume_info.get("fallbacks") or ():
+                telemetry.fault_event(
+                    rnd=-1, kind="corrupt_checkpoint",
+                    detail=fb.get("error"), checkpoint=fb.get("path"))
+
+    def _ledger_sidecar():
+        return collect_ledger_state(qledger=qledger, participation=ledger,
+                                    monitor=monitor, telemetry=telemetry)
+
+    # graceful preemption: the FIRST SIGTERM/SIGINT sets a flag this
+    # loop notices at the next round boundary (drain within
+    # --preempt_grace: close the pipeline, flush the async pool, write
+    # a preempt-tagged round-granular checkpoint, fsync a final fault
+    # event, exit 0); a SECOND signal force-exits. Constructed here;
+    # INSTALLED (and the watchdog thread started) immediately before
+    # the try whose finally reclaims them — an exception in the setup
+    # code between must not leak a replaced signal handler or a thread
+    guard = PreemptGuard(cfg.preempt_grace)
+    # hang watchdog (--watchdog): deadline each round's dispatch+sync at
+    # watchdog_mult x the rolling median round time; on expiry fire a
+    # critical round_stall alert THROUGH the monitor and record an
+    # events-only flight-recorder bundle (never a state fetch — that is
+    # the operation that may be hung)
+    watchdog = None
+    if cfg.watchdog:
+        def _on_stall(rnd, elapsed, deadline):
+            msg = (f"round {rnd} exceeded its stall deadline: "
+                   f"{elapsed:.1f}s > {deadline:.1f}s")
+            print(f"WATCHDOG: {msg}", file=sys.stderr)
+            if monitor is not None:
+                monitor.external_alert(rnd=rnd, rule="round_stall",
+                                       metric="round.wall_s",
+                                       value=float(elapsed))
+            if telemetry is not None:
+                telemetry.fault_event(rnd=rnd, kind="round_stall",
+                                      detail=msg)
+                telemetry.fsync()
+            if recorder is not None:
+                recorder.record(None, {"rule": "round_stall",
+                                       "round": int(rnd),
+                                       "elapsed_s": float(elapsed),
+                                       "deadline_s": float(deadline)})
     adv_plan = getattr(runtime, "adversary_plan", None)
     defense_on = (cfg.defense != "none" or cfg.adversary != "none"
                   or cfg.nonfinite_action == "quarantine")
@@ -439,26 +525,139 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
 
     spe = max(epoch_sampler(0).epoch_rounds(), 1)
     total_download_mb = total_upload_mb = 0.0
-    global_round = start_epoch * spe
+    # resume: the global counter continues from the EXACT round the
+    # checkpoint recorded. epoch_rounds() is an upper bound (a sampler
+    # can strand an underfull tail and end an epoch early), so deriving
+    # the counter as start_epoch * spe can over-number the resumed
+    # rounds — shifting every LR lookup and round-keyed RNG off the
+    # uninterrupted trajectory. Pre-meta checkpoints (global_round
+    # unrecorded) keep the old derivation.
+    resume_global = int((resume_info or {}).get("global_round", -1))
+    global_round = (resume_global if resume_global >= 0
+                    else start_epoch * spe + start_round)
     rounds_run = 0
     summary = None
 
     # round input fetch, shared by the pipelined and inline paths
     # (core/pipeline.py): all randomness keys off the GLOBAL round index,
     # so prefetching ahead cannot change what trains
-    def fetch_round(rnd, g_round: int):
+    def _fetch_round(rnd, g_round: int):
         if train_store is not None:
             return train_store.round_batch(
                 rnd.idx, jax.random.fold_in(data_key, g_round))
         b = train_ds.gather(rnd.idx)
         return {k: jnp.asarray(v) for k, v in b.items()}
 
+    if cfg.watchdog:
+        # the retryable host-side phases (DeviceStore gather dispatch /
+        # host gather + device_put) get bounded exponential-backoff
+        # retries before the round is declared dead — gated on the
+        # watchdog opt-in so the lockstep paths keep strict fail-fast
+        def fetch_round(rnd, g_round: int):
+            def _note(attempt, err):
+                if telemetry is not None:
+                    telemetry.fault_event(
+                        rnd=g_round, kind="fetch_retry",
+                        detail=f"attempt {attempt}: {err}")
+            return with_retries(lambda: _fetch_round(rnd, g_round),
+                                attempts=3, desc=f"round {g_round} input "
+                                "fetch", on_retry=_note)
+    else:
+        fetch_round = _fetch_round
+
     if cfg.eval_before_start:
         test_loss, test_acc = run_validation(runtime, state, val_ds, cfg,
                                              val_store=val_store)
         print(f"Test acc at epoch 0: {test_acc:0.4f}")
 
+    def _preempt_drain(state, cur_epoch, r_in_epoch, pipe,
+                       existing_ckpt=None):
+        """The --preempt_grace drain: reclaim the prefetch thread, flush
+        the async pool through the existing epoch-flush path (no open
+        buffer ever reaches a checkpoint), write an out-of-cadence
+        `preempt`-tagged checkpoint with round-granular meta + the
+        host-ledger sidecar, and fsync the stream behind a final
+        `fault` event. The caller returns (state, None) and the driver
+        process exits 0 — a preemption is an orderly handoff, not a
+        failure. The grace budget is ENFORCED: a drain that wedges
+        (checkpoint save against a hung device, a flush stuck in a dead
+        collective) is force-exited when the remaining budget runs out
+        — the resume then falls back to the last durable checkpoint.
+        ``existing_ckpt`` names an epoch-cadence checkpoint of the SAME
+        state written moments ago (the preemption-during-validation
+        case): re-saving multi-GB state inside the grace window would
+        only burn the budget, so the drain reuses it."""
+        remaining = max(cfg.preempt_grace - (guard.grace_used_s() or 0.0),
+                        1.0)
+        force_timer = guard.force_exit_after(remaining)
+        try:
+            return _drain_body(state, cur_epoch, r_in_epoch, pipe,
+                               existing_ckpt)
+        finally:
+            force_timer.cancel()
+
+    def _flush_async(state):
+        """Drain the in-flight pool and commit any partial buffer,
+        recording each commit — ONE implementation for the epoch
+        boundary and the preempt drain, so checkpoints written by
+        either always see a closed buffer with identical semantics."""
+        if async_agg is None:
+            return state
+        flush_lr = schedule(global_round / spe)
+        flush_lr_arr = (jnp.asarray(flush_lr, jnp.float32)
+                        if lr_mult is None else flush_lr * lr_mult)
+        state, fcommits = async_agg.flush(state, flush_lr_arr)
+        if telemetry is not None:
+            for c in fcommits:
+                telemetry.async_round_event(rec=c, lr=float(flush_lr),
+                                            loss=commit_loss(c),
+                                            with_device=True)
+        return state
+
+    def _drain_body(state, cur_epoch, r_in_epoch, pipe, existing_ckpt):
+        if pipe is not None:
+            pipe.close()
+        state = _flush_async(state)
+        ck_path = None
+        if existing_ckpt is not None:
+            ck_path = existing_ckpt
+        elif ckpt_mgr is not None:
+            ck_path = ckpt_mgr.save(
+                state, cur_epoch,
+                meta={"global_round": int(global_round),
+                      "ledgers": _ledger_sidecar()},
+                round_in_epoch=r_in_epoch, tag="preempt")
+        else:
+            print("PREEMPT WARNING: no checkpoint manager configured — "
+                  "draining WITHOUT a checkpoint; progress since the "
+                  "last save is lost on restart", file=sys.stderr)
+        grace = guard.grace_used_s()
+        print(f"PREEMPT: drained at epoch {cur_epoch} + {r_in_epoch} "
+              f"round(s) (global round {global_round})"
+              + (f"; checkpoint {ck_path}" if ck_path else "")
+              + (f"; grace used {grace:.1f}s of {cfg.preempt_grace:.0f}s"
+                 if grace is not None else ""))
+        prof.finalize(lambda: jax.block_until_ready(state.ps_weights))
+        if telemetry is not None:
+            telemetry.fault_event(rnd=global_round, kind="preempt",
+                                  signal=guard.signal_name, grace_s=grace,
+                                  checkpoint=ck_path)
+            telemetry.span_event(tracer)
+            telemetry.write_summary(
+                aborted=True, n_rounds=rounds_run,
+                total_download_mib=total_download_mb,
+                total_upload_mib=total_upload_mb,
+                final=telemetry.last_epoch)
+            telemetry.fsync()
+        return state
+
     pipe = None
+    # arm the preemption layer LAST: the finally below owns handler
+    # restoration and thread reclamation, so nothing between creation
+    # and here may raise with them live
+    guard.install()
+    if cfg.watchdog:
+        watchdog = RoundWatchdog(_on_stall, mult=cfg.watchdog_mult)
     try:
         for epoch in range(start_epoch, math.ceil(cfg.num_epochs)):
             epoch_fraction = (cfg.num_epochs - epoch
@@ -468,15 +667,31 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
             # epoch cap (reference cv_train.py:194-196) and the global
             # round numbering; with --no_pipeline it degrades to the same
             # fetch inline (bit-identical rounds, see core/pipeline.py)
+            # round-granular resume: the resumed epoch rebuilds its
+            # (seed, epoch) sampler and fast-forwards past the rounds
+            # the preempt checkpoint already trained (skip=; fetches
+            # nothing for them, numbering continues exactly)
+            epoch_skip = start_round if epoch == start_epoch else 0
+            r_in_epoch = epoch_skip
             pipe = RoundPipeline(
                 epoch_sampler(epoch), fetch_round,
-                start_round=global_round,
+                start_round=global_round - epoch_skip,
                 max_rounds=(1 if cfg.do_test
                             else int(math.ceil(spe * epoch_fraction))),
-                depth=cfg.prefetch_depth, enabled=cfg.pipeline)
+                depth=cfg.prefetch_depth, enabled=cfg.pipeline,
+                skip=epoch_skip)
             for item in pipe:
+                if guard.requested:
+                    # graceful preemption: the just-fetched item has NOT
+                    # trained — r_in_epoch counts only consumed rounds,
+                    # so the resume replays exactly from here
+                    state = _preempt_drain(state, epoch, r_in_epoch,
+                                           pipe)
+                    return state, None
                 rnd, batch = item.rnd, item.batch
                 global_round = item.global_round
+                r_in_epoch += 1
+                maybe_fault("pre_round", global_round)
                 if qledger is not None:
                     # bench quarantined clients at DISPATCH time (the
                     # prefetched Round is shared state — never mutated):
@@ -491,6 +706,10 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                 lr_arr = (jnp.asarray(lr, jnp.float32) if lr_mult is None
                           else lr * lr_mult)
                 prof.maybe_start(global_round)
+                if watchdog is not None:
+                    # deadline the dispatch+sync (the phases a hung
+                    # collective or wedged transfer actually blocks)
+                    watchdog.arm(global_round)
                 commits = ()
                 if async_agg is not None:
                     # metrics is None for a scenario-dropped cohort (no
@@ -510,6 +729,7 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                 record = (telemetry is not None and every
                           and global_round % every == 0
                           and metrics is not None)
+                maybe_fault("mid_round", global_round)
                 t_device = t_dispatch
                 if record:
                     # each round record costs ONE host sync of the round's
@@ -519,6 +739,11 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                     with tracing.span("device_wait"):
                         jax.block_until_ready(metrics)
                     t_device = time.perf_counter()
+                if watchdog is not None:
+                    # only synced (record) rounds feed the deadline
+                    # history — a dispatch-only duration is not a round
+                    # time (see RoundWatchdog.disarm)
+                    watchdog.disarm(observe=record)
                 if util is not None and metrics is not None:
                     # device_s is only measured on synced (record) rounds;
                     # the tracker treats None as "not measured", not zero.
@@ -772,20 +997,11 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
             # which is fine only because nothing trains on this dataset
             # stream afterwards (see RoundPipeline.close)
             pipe.close()
-            if async_agg is not None:
-                # drain the in-flight pool and commit any partial buffer:
-                # epochs (and therefore checkpoints, which are written at
-                # epoch granularity below) never straddle an open buffer
-                flush_lr = schedule(global_round / spe)
-                flush_lr_arr = (jnp.asarray(flush_lr, jnp.float32)
-                                if lr_mult is None else flush_lr * lr_mult)
-                state, fcommits = async_agg.flush(state, flush_lr_arr)
-                if telemetry is not None:
-                    for c in fcommits:
-                        telemetry.async_round_event(rec=c,
-                                                    lr=float(flush_lr),
-                                                    loss=commit_loss(c),
-                                                    with_device=True)
+            # drain the in-flight pool and commit any partial buffer:
+            # epochs (and therefore checkpoints, which are written at
+            # epoch granularity below) never straddle an open buffer —
+            # shared with the preempt drain (_flush_async)
+            state = _flush_async(state)
             if util is not None:
                 # close the round window at the epoch boundary: the
                 # validation sweep below must not dilute the round MFU
@@ -903,15 +1119,35 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                 writer.add_scalar("Time/test", test_time, epoch)
                 writer.add_scalar("Time/total", timer.total_time, epoch)
                 writer.add_scalar("Lr", summary["lr"], epoch)
+            epoch_ck_path = None
             if (ckpt_mgr is not None and cfg.checkpoint_every
                     and (epoch + 1) % cfg.checkpoint_every == 0):
-                ckpt_mgr.save(state, epoch + 1, meta={"summary": summary})
+                # epoch-cadence checkpoints carry the SAME round-
+                # granular meta + host-ledger sidecar as the preempt
+                # path: even an epoch-granular resume must not silently
+                # un-bench/un-eject quarantined clients or reset the
+                # monitor's rolling envelopes
+                epoch_ck_path = ckpt_mgr.save(
+                    state, epoch + 1,
+                    meta={"summary": summary,
+                          "global_round": int(global_round),
+                          "ledgers": _ledger_sidecar()})
                 if telemetry is not None:
                     # the third phase of the residency attribution:
                     # delta_peak_bytes here is the checkpoint writer's
                     # high-water contribution (host-side gathers of a
                     # sharded state can spike device residency too)
                     telemetry.memory_event(f"checkpoint_{epoch + 1}")
+            if guard.requested:
+                # preemption landed during validation/checkpointing:
+                # drain at the epoch boundary (epoch+1 complete, 0
+                # rounds into the next). A cadence checkpoint written
+                # just above holds this exact state (the async pool was
+                # flushed BEFORE it) — reuse it instead of re-saving
+                # inside the grace window
+                state = _preempt_drain(state, epoch + 1, 0, pipe,
+                                       existing_ckpt=epoch_ck_path)
+                return state, None
             if cfg.do_test:
                 break
 
@@ -928,6 +1164,12 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
         # the epoch-boundary close above makes this a no-op normally
         if pipe is not None:
             pipe.close()
+        # restore the process's previous signal handlers and reclaim
+        # the watchdog thread on every exit path — no leaked handlers
+        # or threads, whatever killed the loop
+        guard.uninstall()
+        if watchdog is not None:
+            watchdog.close()
         # release the process-global span tracer however the loop ends
         # (the tail below only DRAINS the local tracer object, which
         # stays valid after uninstall)
@@ -1009,7 +1251,7 @@ def main(argv=None):
         print("using fixup learning rates")
         lr_mult = fixup_lr_multiplier(params, runtime.initial_weights)
 
-    ckpt_mgr, start_epoch, restored = setup_checkpointing(
+    ckpt_mgr, start_epoch, restored, resume_info = setup_checkpointing(
         cfg, runtime, cfg.model)
     if restored is not None:
         state = restored
@@ -1017,13 +1259,20 @@ def main(argv=None):
     print(f"Finished initializing in {timer():.2f} seconds")
     # ONE logdir for the whole run: telemetry and the tensorboard writer
     # must share it (make_logdir timestamps at second resolution — two
-    # calls can split the artifacts across sibling directories)
-    logdir = (make_logdir(cfg)
+    # calls can split the artifacts across sibling directories).
+    # --logdir pins it: a resumed run pointed at its predecessor's
+    # directory APPENDS to the stream behind a `resume` lineage record
+    logdir = (cfg.logdir or make_logdir(cfg)
               if cfg.telemetry or cfg.use_tensorboard else None)
     # telemetry opens against the runtime's RESOLVED config (grad_size
     # filled in, num_cols auto-sized) so the manifest records the run
     # that actually executes
-    telemetry = make_telemetry(runtime.cfg, "cv_train", logdir=logdir)
+    telemetry = make_telemetry(
+        runtime.cfg, "cv_train", logdir=logdir,
+        resume_info=(None if resume_info is None else {
+            "round": resume_info["global_round"],
+            "epoch": start_epoch,
+            "checkpoint": resume_info["checkpoint"]}))
     if telemetry is not None:
         telemetry.instrument(runtime)
         telemetry.memory_event("init")
@@ -1034,7 +1283,8 @@ def main(argv=None):
                                timer=timer, ckpt_mgr=ckpt_mgr,
                                start_epoch=start_epoch,
                                writer=make_writer(cfg, logdir=logdir),
-                               telemetry=telemetry)
+                               telemetry=telemetry,
+                               resume_info=resume_info)
     finally:
         if telemetry is not None:
             telemetry.close()

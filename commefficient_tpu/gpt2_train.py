@@ -221,7 +221,7 @@ def main(argv=None):
     print(f"grad size {runtime.cfg.grad_size}; "
           f"initialized in {timer():.2f}s")
 
-    ckpt_mgr, start_epoch, restored = setup_checkpointing(
+    ckpt_mgr, start_epoch, restored, resume_info = setup_checkpointing(
         cfg, runtime, "gpt2_doubleheads")
     if restored is not None:
         state = restored
@@ -229,11 +229,18 @@ def main(argv=None):
     from commefficient_tpu.cv_train import make_writer
     from commefficient_tpu.telemetry import maybe_create as make_telemetry
     from commefficient_tpu.utils import make_logdir
-    # one logdir shared by telemetry + tensorboard (see cv_train.main)
-    logdir = (make_logdir(cfg)
+    # one logdir shared by telemetry + tensorboard (see cv_train.main);
+    # --logdir pins it so a resumed run appends to its predecessor's
+    # stream with a `resume` lineage record
+    logdir = (cfg.logdir or make_logdir(cfg)
               if cfg.telemetry or cfg.use_tensorboard else None)
     # resolved config (grad_size, auto-sized num_cols) for the manifest
-    telemetry = make_telemetry(runtime.cfg, "gpt2_train", logdir=logdir)
+    telemetry = make_telemetry(
+        runtime.cfg, "gpt2_train", logdir=logdir,
+        resume_info=(None if resume_info is None else {
+            "round": resume_info["global_round"],
+            "epoch": start_epoch,
+            "checkpoint": resume_info["checkpoint"]}))
     if telemetry is not None:
         telemetry.instrument(runtime)
         telemetry.memory_event("init")
@@ -252,7 +259,8 @@ def main(argv=None):
                                       schedule=make_gpt2_schedule(cfg),
                                       writer=make_writer(cfg, logdir=logdir),
                                       telemetry=telemetry,
-                                      model_flops_per_round=round_flops)
+                                      model_flops_per_round=round_flops,
+                                      resume_info=resume_info)
     finally:
         if telemetry is not None:
             telemetry.close()

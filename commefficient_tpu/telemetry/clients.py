@@ -175,6 +175,22 @@ class ParticipationLedger:
     def distinct(self) -> int:
         return len(self._samples)
 
+    def state_dict(self) -> Dict[str, Any]:
+        """JSON-serializable ledger state for checkpoint meta: a resumed
+        run keeps its coverage/staleness view of the universe instead of
+        reporting coverage ~0 until every client is re-seen."""
+        return {
+            "samples": {str(c): n for c, n in self._samples.items()},
+            "last_round": {str(c): r
+                           for c, r in self._last_round.items()},
+        }
+
+    def load_state_dict(self, d: Dict[str, Any]) -> None:
+        self._samples = {int(c): float(n)
+                         for c, n in (d.get("samples") or {}).items()}
+        self._last_round = {int(c): int(r)
+                            for c, r in (d.get("last_round") or {}).items()}
+
     def snapshot(self, rnd: int) -> Dict[str, Any]:
         if not self._samples:
             return {"coverage": 0.0, "distinct_clients": 0,

@@ -293,7 +293,45 @@ class AnomalyMonitor:
             self._fire(alert)
         return fired
 
+    # ----------------------------------------------------------- persistence
+
+    def state_dict(self) -> Dict[str, Any]:
+        """JSON-serializable rolling state for checkpoint meta: the
+        per-metric median/MAD histories, cooldowns and nonfinite
+        counters. Without this a resumed monitor restarts COLD — its
+        statistical rules are disarmed for min_points observations and
+        a divergence straddling the restart goes unflagged."""
+        return {
+            "hist": {m: list(h) for m, h in self._hist.items()},
+            "quiet": dict(self._quiet),
+            "nonfinite_counts": dict(self.nonfinite_counts),
+            "n_observed": self.n_observed,
+        }
+
+    def load_state_dict(self, d: Dict[str, Any]) -> None:
+        self._hist = {m: deque((float(x) for x in h),
+                               maxlen=self.window)
+                      for m, h in (d.get("hist") or {}).items()}
+        self._quiet = {r: int(q)
+                       for r, q in (d.get("quiet") or {}).items()}
+        self.nonfinite_counts = {m: int(n) for m, n in
+                                 (d.get("nonfinite_counts") or {}).items()}
+        self.n_observed = int(d.get("n_observed", 0))
+
     # --------------------------------------------------------------- actions
+
+    def external_alert(self, *, rnd: int, rule: str, metric: str,
+                       value: Optional[float] = None,
+                       severity: str = "critical") -> Dict[str, Any]:
+        """Fire a non-statistical alert THROUGH the monitor (the hang
+        watchdog's round_stall path): the alert event is written, the
+        configured action's side effects (stderr, snapshot request,
+        abort request) apply, exactly as if a rule had fired."""
+        alert = dict(round=int(rnd), rule=rule, severity=severity,
+                     metric=metric, value=value, zscore=None, median=None,
+                     mad=None, window=0, action=self.action)
+        self._fire(alert)
+        return alert
 
     def _fire(self, alert: Dict[str, Any]) -> None:
         self.alerts.append(alert)
@@ -342,19 +380,52 @@ class FlightRecorder:
         self.path = os.path.join(logdir, subdir)
         self._telemetry = telemetry
         self.written: Optional[str] = None
+        # whether the bundle on disk carries state.npz: an events-only
+        # stall bundle must not consume the one-shot slot for state —
+        # see record()
+        self._state_written = False
 
     def record(self, state, context: Dict[str, Any]) -> Optional[str]:
+        """``state=None`` writes an events-only bundle (no ``state.npz``)
+        — the hang-watchdog path, where fetching device state is exactly
+        the operation that may be hung. One-shot applies to the EVENTS
+        side; a later state-carrying alert (NaN abort after a stall
+        alert already claimed the bundle) UPGRADES the bundle with
+        ``state.npz`` instead of being swallowed — the recorder exists
+        for exactly that snapshot."""
         if self.written is not None:
+            if state is None or self._state_written:
+                return self.written
+            # upgrade path: add the state snapshot to the existing
+            # events-only bundle; the first firing's events/alert.json
+            # (the earliest anomalous window) stay as written
+            try:
+                from commefficient_tpu.checkpoint import save_postmortem
+                save_postmortem(os.path.join(self.path, "state"), state,
+                                meta={"alert": context})
+                self._state_written = True
+                print(f"flight recorder: state.npz added to the "
+                      f"events-only bundle at {self.path}",
+                      file=sys.stderr)
+            except Exception as e:  # noqa: BLE001
+                print(f"WARNING: flight recorder state upgrade failed "
+                      f"({e})", file=sys.stderr)
             return self.written
         try:
-            from commefficient_tpu.checkpoint import save_postmortem
             os.makedirs(self.path, exist_ok=True)
-            save_postmortem(os.path.join(self.path, "state"), state,
-                            meta={"alert": context})
+            if state is not None:
+                from commefficient_tpu.checkpoint import save_postmortem
+                save_postmortem(os.path.join(self.path, "state"), state,
+                                meta={"alert": context})
+                self._state_written = True
             if self._telemetry is not None:
                 with open(os.path.join(self.path, "events.jsonl"),
                           "w") as f:
-                    for ev in self._telemetry.recent:
+                    # snapshot: the watchdog thread records bundles while
+                    # the round loop keeps appending to the ring —
+                    # iterating the live deque would raise mutated-
+                    # during-iteration and lose the bundle
+                    for ev in list(self._telemetry.recent):
                         f.write(json.dumps(ev) + "\n")
                     f.flush()
                     os.fsync(f.fileno())

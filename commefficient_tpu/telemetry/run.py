@@ -20,6 +20,7 @@ import sys
 import time
 from typing import Any, Dict, Optional
 
+from commefficient_tpu.faults import fault_matches, trigger
 from commefficient_tpu.telemetry.compilewatch import JitWatcher
 from commefficient_tpu.telemetry.schema import (SCHEMA_VERSION,
                                                 TELEMETRY_BASENAME)
@@ -68,11 +69,26 @@ class RunTelemetry:
     stages land in the same file)."""
 
     def __init__(self, logdir: str, run_type: str, cfg=None,
-                 manifest_extra: Optional[Dict[str, Any]] = None):
+                 manifest_extra: Optional[Dict[str, Any]] = None,
+                 resume_info: Optional[Dict[str, Any]] = None):
         self.logdir = logdir
         self.run_type = run_type
         self.path = os.path.join(logdir, TELEMETRY_BASENAME)
         self._seq = 0
+        # serialize writers: the round loop owns most events, but the
+        # hang watchdog's stall callback and the prefetch worker's
+        # fetch-retry notes write from THEIR threads — without a lock
+        # two writers could allocate the same seq (a validator-visible
+        # corruption) or interleave half-lines in the shared buffer
+        import threading
+        # RLock: the monitor forwarding at the end of event() can fire
+        # an alert that re-enters event() on the same thread
+        self._lock = threading.RLock()
+        # unique segment id: a resumed run appends a new manifest with a
+        # fresh id, and its `resume` event names the predecessor's —
+        # the crash-recovery lineage chain (schema v8)
+        self.stream_id = (f"{run_type}-{os.getpid()}-"
+                          f"{int(time.time() * 1000):x}")
         # durations come off the monotonic clock: an NTP step during the
         # run must not produce a negative/skewed wall_time_s. time.time()
         # stays only for the absolute `t` envelope field.
@@ -95,15 +111,86 @@ class RunTelemetry:
         # residency tracker (telemetry/memory_ledger.py): previous-peak
         # state for delta attribution + the one-time CPU-degradation note
         self._residency = None
+        prior = None
         try:
             os.makedirs(logdir, exist_ok=True)
-            self._file = open(self.path, "w")
+            if (os.path.exists(self.path)
+                    and os.path.getsize(self.path) > 0):
+                # NEVER clobber an existing stream with mode "w": the
+                # file is a predecessor segment (a crashed or preempted
+                # run pointed at the same logdir) and this run APPENDS
+                # to it behind a `resume` lineage record. The prior
+                # run's records — the whole point of a postmortem —
+                # survive the restart.
+                prior = self._scan_prior()
+                self._file = open(self.path, "a")
+                if prior["needs_newline"]:
+                    # the predecessor died mid-line; terminate the
+                    # truncated fragment so appended events stay
+                    # line-delimited (the analyzer already tolerates
+                    # one malformed line, schema lint flags it)
+                    self._file.write("\n")
+                self._seq = prior["last_seq"] + 1
+            else:
+                self._file = open(self.path, "w")
         except OSError as e:
             print(f"WARNING: telemetry disabled ({e})", file=sys.stderr)
             return
+        info = dict(resume_info or {})
+        if prior is not None:
+            # segment boundary marker FIRST (lineage: which segment this
+            # continues, and how far it had written), then the fresh
+            # manifest — the stream's first line is still the original
+            # manifest, so the shape contract holds
+            self.resume_event(rnd=int(info.get("round", -1)),
+                              epoch=info.get("epoch"),
+                              checkpoint=info.get("checkpoint"),
+                              prior_stream=prior["stream_id"],
+                              prior_events=prior["last_seq"] + 1)
         self.event("manifest", schema=SCHEMA_VERSION, run_type=run_type,
+                   stream_id=self.stream_id,
                    **self._environment(), **self._config_fields(cfg),
                    **(manifest_extra or {}))
+        if prior is None and resume_info is not None:
+            # a resumed run writing into a FRESH logdir still records
+            # its lineage (checkpoint + resume round; no prior segment
+            # in this file to name)
+            self.resume_event(rnd=int(info.get("round", -1)),
+                              epoch=info.get("epoch"),
+                              checkpoint=info.get("checkpoint"),
+                              prior_stream=info.get("prior_stream"),
+                              prior_events=None)
+
+    def _scan_prior(self) -> Dict[str, Any]:
+        """Lineage of the existing stream this run appends to: the
+        predecessor manifest's stream_id, the last valid seq (ours
+        continue from there — the validator's contiguity check spans
+        segments), and whether the final line was truncated mid-write.
+        Streams line-by-line: a long predecessor run's file can be
+        hundreds of MB, and buffering it (plus its decoded copy) would
+        double the resume's peak memory for three scalar answers."""
+        stream_id = None
+        last_seq = -1
+        with open(self.path, "r", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(obj, dict):
+                    continue
+                if obj.get("event") == "manifest" and obj.get("stream_id"):
+                    stream_id = obj["stream_id"]
+                if isinstance(obj.get("seq"), int):
+                    last_seq = max(last_seq, obj["seq"])
+        with open(self.path, "rb") as f:
+            f.seek(-1, os.SEEK_END)
+            needs_newline = f.read(1) != b"\n"
+        return {"stream_id": stream_id, "last_seq": last_seq,
+                "needs_newline": needs_newline}
 
     # -------------------------------------------------------------- plumbing
 
@@ -137,9 +224,17 @@ class RunTelemetry:
             "config": _jsonable(dataclasses.asdict(cfg)),
         }
 
-    def event(self, kind: str, **fields) -> None:
+    def event(self, kind: str, /, **fields) -> None:
         """Append one event; never raises — a full disk or closed stream
-        prints one warning and disables further telemetry."""
+        prints one warning and disables further telemetry. The event
+        type is positional-only so a field may itself be named "kind"
+        (the v8 `fault` event's fault-kind). Thread-safe: writers off
+        the round loop (the watchdog's stall callback, the prefetch
+        worker's fetch-retry notes) serialize on the instance lock."""
+        with self._lock:
+            self._event_locked(kind, fields)
+
+    def _event_locked(self, kind: str, fields) -> None:
         if self._file is None:
             return
         record = {"event": kind, "t": time.time(), "seq": self._seq}
@@ -147,9 +242,25 @@ class RunTelemetry:
         try:
             # allow_nan=False backstops _jsonable's non-finite mapping:
             # the stream must never contain tokens strict parsers reject
-            self._file.write(json.dumps(record, allow_nan=False) + "\n")
+            line = json.dumps(record, allow_nan=False)
+            if fault_matches("mid_telemetry_flush", self._seq):
+                # crash-matrix kill-point: half a line reaches the file,
+                # the process dies unflushed — the resumed run's append
+                # path must repair the truncated fragment
+                self._file.write(line[: max(len(line) // 2, 1)])
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                trigger("mid_telemetry_flush")
+                # sigterm action: trigger() RETURNS (the graceful drain
+                # owns what happens next) — terminate the staged
+                # fragment so the full line below starts on its own
+                # line instead of merging into a permanently malformed
+                # record no successor would ever repair
+                self._file.write("\n")
+            self._file.write(line + "\n")
             self._file.flush()
-            if kind in ("alert", "nan_abort", "summary"):
+            if kind in ("alert", "nan_abort", "summary", "fault",
+                        "resume"):
                 # the events a postmortem reader needs most are exactly
                 # the ones written while the run is dying: push them
                 # through the OS cache so a crash cannot truncate them
@@ -407,6 +518,33 @@ class RunTelemetry:
                    metric=metric, value=value, zscore=zscore, median=median,
                    mad=mad, window=int(window), action=action)
 
+    def fault_event(self, *, rnd: int, kind: str,
+                    signal: Optional[str] = None,
+                    grace_s: Optional[float] = None,
+                    detail: Optional[str] = None,
+                    checkpoint: Optional[str] = None) -> None:
+        """One run-level fault (schema v8, core/preempt.py): a graceful
+        preemption drain, a corrupt-checkpoint fallback at resume, a
+        watchdog round_stall, an input-phase retry. Fsynced on write
+        (see event()) — a fault record that the fault itself truncates
+        would be useless."""
+        self.event("fault", round=int(rnd), kind=kind, signal=signal,
+                   grace_s=(round(float(grace_s), 3)
+                            if grace_s is not None else None),
+                   detail=detail, checkpoint=checkpoint)
+
+    def resume_event(self, *, rnd: int, epoch: Optional[int] = None,
+                     checkpoint: Optional[str] = None,
+                     prior_stream: Optional[str] = None,
+                     prior_events: Optional[int] = None) -> None:
+        """Crash-recovery lineage record (schema v8). The append-mode
+        constructor writes one automatically when it continues an
+        existing stream; drivers use this form when the resumed run
+        lands in a fresh logdir."""
+        self.event("resume", round=int(rnd), epoch=epoch,
+                   checkpoint=checkpoint, prior_stream=prior_stream,
+                   prior_events=prior_events)
+
     def span_event(self, tracer) -> None:
         """Drain a tracing.SpanTracer's completed spans into one batched
         ``span`` event. Call OUTSIDE the timed region (the drivers do it
@@ -442,17 +580,20 @@ class RunTelemetry:
                    final=final)
 
 
-def maybe_create(cfg, run_type: str,
-                 logdir: Optional[str] = None) -> Optional[RunTelemetry]:
+def maybe_create(cfg, run_type: str, logdir: Optional[str] = None,
+                 resume_info: Optional[Dict[str, Any]] = None
+                 ) -> Optional[RunTelemetry]:
     """Driver entry point: honor --no_telemetry, default the logdir to
     the run's ``make_logdir`` location, announce the path on stderr
-    (stdout belongs to the byte-stable console loggers)."""
+    (stdout belongs to the byte-stable console loggers).
+    ``resume_info`` ({round, epoch, checkpoint}) threads the restored
+    position into the stream's `resume` lineage record."""
     if not getattr(cfg, "telemetry", True):
         return None
     if logdir is None:
         from commefficient_tpu.utils import make_logdir
         logdir = make_logdir(cfg)
-    tel = RunTelemetry(logdir, run_type, cfg=cfg)
+    tel = RunTelemetry(logdir, run_type, cfg=cfg, resume_info=resume_info)
     if not tel.active:
         # the constructor already warned; do not announce (or hand the
         # caller) a stream that was never created

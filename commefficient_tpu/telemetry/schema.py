@@ -20,7 +20,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterable, List, Tuple
 
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 # streams written by older code stay readable: v1 lacks the span /
 # utilization event types (added in v2), v2 lacks client_stats / alert
 # (added in v3), v3 lacks async_round (added in v4), v4 lacks defense
@@ -29,11 +29,13 @@ SCHEMA_VERSION = 7
 # existing event types; see FIELDS_SINCE_V6, which the validator only
 # requires of v6+ streams), v6 lacks the utilization mesh-topology
 # fields (n_devices / mesh_shape, added in v7 for the scaling-curve
-# harness — FIELDS_SINCE_V7, same vintage-gated requirement), but each
-# is otherwise a subset of its successor — so the validator accepts any
-# supported manifest version. A version it does not know is the error,
-# not a version merely older than current.
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, SCHEMA_VERSION)
+# harness — FIELDS_SINCE_V7, same vintage-gated requirement), v7 lacks
+# the fault/resume event types and the manifest stream_id (added in v8
+# for crash recovery lineage — FIELDS_SINCE_V8), but each is otherwise
+# a subset of its successor — so the validator accepts any supported
+# manifest version. A version it does not know is the error, not a
+# version merely older than current.
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, SCHEMA_VERSION)
 TELEMETRY_BASENAME = "telemetry.jsonl"
 
 
@@ -93,6 +95,10 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "grad_size": _int,
         "sketch": _opt_dict,       # geometry dict in sketch mode, else null
         "config": _dict,           # full resolved FedConfig
+        # schema v8: unique id of this stream SEGMENT — a resumed run
+        # appends a new manifest with a fresh id, and its `resume`
+        # event names the predecessor's (crash-recovery lineage)
+        "stream_id": _str,
     },
     # one federated round (emitted every cfg.telemetry_every rounds).
     # loss/acc are null when the round's metrics went non-finite — the
@@ -352,6 +358,37 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "quarantine_ids_digest": _opt_str,  # "<n>:<sha1[:12]>" or null
         "injected": _opt_dict,        # {kind: slots-this-round} when on
     },
+    # a run-level fault (schema v8, core/preempt.py + the drivers):
+    # what interrupted or degraded the run, and what survived it. kind:
+    # "preempt" = graceful SIGTERM/SIGINT drain (signal + grace used +
+    # the preempt-tagged checkpoint written); "corrupt_checkpoint" = a
+    # resume fell back past a damaged generation (detail names it);
+    # "round_stall" = the hang watchdog's deadline expired;
+    # "fetch_retry" = a retryable input phase needed a backoff retry.
+    # round is -1 when no round context exists (a fault at resume
+    # time). Numeric/str fields are null where not applicable.
+    "fault": {
+        "round": _int,
+        "kind": _str,             # preempt | corrupt_checkpoint |
+                                  # round_stall | fetch_retry | kill
+        "signal": _opt_str,       # SIGTERM | SIGINT | null
+        "grace_s": _opt_num,      # drain seconds actually used
+        "detail": _opt_str,       # human context (paths, errors)
+        "checkpoint": _opt_str,   # checkpoint written/skipped, if any
+    },
+    # crash-recovery lineage (schema v8): a resumed run's first records.
+    # Written when the stream is opened in APPEND mode over a
+    # predecessor's events.jsonl (prior_stream/prior_events name the
+    # segment it continues) and/or when the driver restores a
+    # checkpoint (round/epoch/checkpoint say where training resumes;
+    # round is -1 when only the stream — not training state — resumed).
+    "resume": {
+        "round": _int,            # first global round of the resumed run
+        "epoch": _opt_num,
+        "checkpoint": _opt_str,   # the generation restored from
+        "prior_stream": _opt_str,  # predecessor segment's stream_id
+        "prior_events": _opt_num,  # events the predecessor had written
+    },
     # online anomaly alert (telemetry/health.py): a monitor rule fired
     # against the rolling median/MAD history of a watched stream field.
     # zscore/median/mad are null for non-statistical rules (nonfinite
@@ -403,6 +440,12 @@ FIELDS_SINCE_V7: Dict[str, Tuple[str, ...]] = {
     "utilization": ("n_devices", "mesh_shape"),
 }
 
+# fields ADDED in schema v8 (crash-recovery lineage) — same vintage-
+# gated requirement: pre-v8 manifests legitimately carry no stream_id
+FIELDS_SINCE_V8: Dict[str, Tuple[str, ...]] = {
+    "manifest": ("stream_id",),
+}
+
 
 def validate_event(obj: Any,
                    version: int = SCHEMA_VERSION) -> List[str]:
@@ -428,11 +471,14 @@ def validate_event(obj: Any,
         return problems
     v6_only = FIELDS_SINCE_V6.get(kind, ())
     v7_only = FIELDS_SINCE_V7.get(kind, ())
+    v8_only = FIELDS_SINCE_V8.get(kind, ())
     for field, pred in spec.items():
         if field not in obj:
             if version < 6 and field in v6_only:
                 continue
             if version < 7 and field in v7_only:
+                continue
+            if version < 8 and field in v8_only:
                 continue
             problems.append(f"{kind}: missing field {field!r}")
         elif not pred(obj[field]):

@@ -123,6 +123,17 @@ _SAMPLE_OVERRIDES = {
     "ejected": 0,
     "quarantine_ids_digest": "1:c1dfd96eea8c",
     "injected": {"scale": 1},
+    # manifest: schema-v8 segment id (crash-recovery lineage)
+    "stream_id": "cv_train-1234-18c2a9f0e01",
+    # fault/resume: one realistic graceful-preemption record + the
+    # resumed segment's lineage (schema v8, core/preempt.py)
+    "kind": "preempt",
+    "signal": "SIGTERM",
+    "grace_s": 4.2,
+    "detail": None,
+    "checkpoint": "./checkpoint/ResNet9/ckpt_000002_r000005_preempt",
+    "prior_stream": "cv_train-1200-18c2a9e77b3",
+    "prior_events": 412,
     # alert: a fired statistical rule
     "rule": "loss_spike",
     "severity": "warn",

@@ -50,6 +50,14 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_fused_encode.py -q \
 env JAX_PLATFORMS=cpu python -m pytest tests/test_sharded_server.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
+# preemption-safe rounds: a regression here (lost bitwise crash-resume,
+# checkpoint-integrity fallback drift, telemetry stream clobbering,
+# quarantine state dropped on restart, a leaked watchdog thread) fails
+# in seconds, before the full suite; the REAL-kill subprocess matrix is
+# scripts/crash_matrix.py (slow-marked here)
+env JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,28 +1,66 @@
 set -euo pipefail
 cd /root/repo
+# Preemption-safe resume demo: kill a run at round k with a real
+# SIGTERM (delivered deterministically via the fault-injection hook at
+# a chosen round), let the graceful drain write a round-granular
+# `preempt`-tagged checkpoint, resume, and verify the stitched
+# trajectory is BIT-identical to an uninterrupted run of the same
+# config. Epoch-granular resume (the pre-PR-13 path) falls out as the
+# tag-less case; the full hard-kill matrix (os._exit at
+# mid-checkpoint-write / mid-telemetry-flush / inside the async pool)
+# is scripts/crash_matrix.py.
 OUT=runs/gpt2_conv
 CK=/tmp/resume_ck
-rm -rf "$CK"
+LOGS=/tmp/resume_logs
+rm -rf "$CK" "$LOGS"
+mkdir -p "$OUT"
 COMMON=(--mode sketch --error_type virtual --num_cols 524288 --num_rows 5
         --k 50000 --approx_topk --num_workers 8 --local_batch_size 8
         --microbatch_size 8 --max_seq_len 64 --valid_batch_size 64
         --weight_decay 0 --local_momentum 0 --virtual_momentum 0.9
         --dataset_dir "$OUT/data" --seed 21 --num_epochs 12
-        --checkpoint_path "$CK")
-# uninterrupted 12-epoch run (checkpoints every 3 so the interrupted
-# variant can resume from epoch 6)
-python gpt2_train.py "${COMMON[@]}" --checkpoint_every 3 \
+        --checkpoint_path "$CK" --checkpoint_every 3 --telemetry_every 1)
+# 1) uninterrupted 12-epoch run — the bitwise reference trajectory
+python gpt2_train.py "${COMMON[@]}" --logdir "$LOGS/straight" \
     2>&1 | tee "$OUT/resume_full12.log"
-# wipe later checkpoints so the resume starts at epoch 6, then resume
-python - "$CK" <<'PYEOF'
-import glob, os, sys
-for fn in glob.glob(os.path.join(sys.argv[1], "gpt2_doubleheads", "*")):
-    base = os.path.basename(fn)
-    if any(f"_{ep:06d}" in base or f"{ep}" == base.split("_")[-1].split(".")[0]
-           for ep in (9, 12)):
-        os.remove(fn)
-        print("removed", base)
+# 2) the same run preempted at global round 20: the injected SIGTERM
+#    triggers the graceful drain (finish the in-flight round, flush,
+#    write ckpt_*_r*_preempt with round-granular meta + ledger sidecar,
+#    emit the `fault` event, exit 0)
+rm -rf "$CK"
+COMMEFFICIENT_FAULT=sigterm:pre_round:20 \
+python gpt2_train.py "${COMMON[@]}" --logdir "$LOGS/killed" \
+    2>&1 | tee "$OUT/resume_killed.log"
+ls -l "$CK/gpt2_doubleheads/" | tee -a "$OUT/resume_killed.log"
+# 3) resume: rebuilds the (seed, epoch) sampler, skips the 20 trained
+#    rounds, continues — and APPENDS to the killed run's telemetry
+#    stream behind a `resume` lineage record (same --logdir)
+python gpt2_train.py "${COMMON[@]}" --resume --logdir "$LOGS/killed" \
+    2>&1 | tee "$OUT/resume_from_kill.log"
+# 4) bitwise gate: every round record in the stitched killed+resumed
+#    stream must carry EXACTLY the loss the uninterrupted run recorded
+python - "$LOGS/straight" "$LOGS/killed" <<'PYEOF'
+import json, sys
+
+def rounds(d):
+    out = {}
+    for line in open(d + "/telemetry.jsonl"):
+        try:
+            e = json.loads(line)
+        except ValueError:
+            continue
+        if e.get("event") == "round":
+            prev = out.get(e["round"])
+            assert prev is None or prev == e["loss"], \
+                f"replayed round {e['round']} diverged: {prev} vs {e['loss']}"
+            out[e["round"]] = e["loss"]
+    return out
+
+a, b = rounds(sys.argv[1]), rounds(sys.argv[2])
+assert a == b, ("killed+resumed trajectory != uninterrupted run: "
+                f"{sorted(set(a) ^ set(b))[:5]} ...")
+print(f"BITWISE OK: {len(a)} rounds identical across the kill+resume")
 PYEOF
-python gpt2_train.py "${COMMON[@]}" --resume \
-    2>&1 | tee "$OUT/resume_from6.log"
+python scripts/teleview.py summarize "$LOGS/killed" \
+    | tee "$OUT/resume_lineage.log"
 echo RESUME DEMO DONE

@@ -363,7 +363,36 @@ def summarize(events: List[Dict[str, Any]], label: str = "") -> None:
               f"[{worst.get('severity')}] {worst.get('rule')} at round "
               f"{worst.get('round')} (run `teleview alerts` for the list)")
 
-    summ = next(iter(by_kind(events, "summary")), None)
+    # crash-recovery lineage (schema v8): a resumed run APPENDS to its
+    # predecessor's stream — each manifest opens a segment, each
+    # `resume` names the segment it continues and the checkpoint/round
+    # it restored, each `fault` names what interrupted a segment
+    mans = by_kind(events, "manifest")
+    resumes = by_kind(events, "resume")
+    fts = by_kind(events, "fault")
+    if len(mans) > 1 or resumes or fts:
+        seg = f"{len(mans)} segment" + ("s" if len(mans) != 1 else "")
+        print(f"-- lineage: {seg} stitched in one stream")
+        for e in resumes:
+            src = e.get("checkpoint") or "no checkpoint (stream only)"
+            print(f"   resume at round {e.get('round')}"
+                  + (f" epoch {e.get('epoch')}"
+                     if e.get("epoch") is not None else "")
+                  + f" from {src}"
+                  + (f" (continues segment {e['prior_stream']}, "
+                     f"{e.get('prior_events')} events)"
+                     if e.get("prior_stream") else ""))
+        for e in fts:
+            print(f"   fault [{e.get('kind')}] at round {e.get('round')}"
+                  + (f" signal {e['signal']}" if e.get("signal") else "")
+                  + (f" grace {e['grace_s']}s"
+                     if e.get("grace_s") is not None else "")
+                  + (f": {e['detail']}" if e.get("detail") else ""))
+
+    # the LAST summary is the lineage's final verdict (earlier segments
+    # that drained gracefully wrote their own aborted footers)
+    summs = by_kind(events, "summary")
+    summ = summs[-1] if summs else None
     if summ is None:
         print("-- NO summary footer: the run DIED before finishing")
     else:
@@ -382,8 +411,18 @@ def alerts(events: List[Dict[str, Any]]) -> int:
     scriptable as a health gate over a finished run's stream."""
     als = by_kind(events, "alert")
     aborts = by_kind(events, "nan_abort")
+    fts = by_kind(events, "fault")
+    for e in fts:
+        # faults are context, not verdicts: a graceful preempt or a
+        # recovered fetch retry must not trip the health gate (a
+        # round_stall also fired its own critical alert, counted below)
+        print(f"   r{e.get('round', '?'):>6} [fault   ] "
+              f"{e.get('kind', '?'):24s}"
+              + (f" signal={e['signal']}" if e.get("signal") else "")
+              + (f" {e['detail']}" if e.get("detail") else ""))
     if not als and not aborts:
-        print("no alerts (and no nan_abort) in the stream")
+        print("no alerts (and no nan_abort) in the stream"
+              + (f" ({len(fts)} fault record(s) above)" if fts else ""))
         return 0
     counts: Dict[str, int] = {}
     for e in als:

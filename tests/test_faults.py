@@ -1,0 +1,818 @@
+"""Preemption-safe rounds (crash recovery, checkpoint integrity, hang
+watchdog, fault injection — core/preempt.py, faults.py, the checkpoint
+fallback and the telemetry append-resume path).
+
+The contract under test: a run interrupted at ANY round — graceful
+SIGTERM drain or hard kill — resumes BIT-identically to the
+uninterrupted run (losses and final weights), keeps its host-ledger
+state (quarantine bench/eject decisions survive the restart), falls
+back a checkpoint generation instead of crashing on a damaged file,
+never clobbers a predecessor's telemetry stream, and leaves no .tmp
+litter or leaked threads behind. Hard kills (os._exit, skipping every
+``finally``) are exercised by the subprocess crash matrix
+(scripts/crash_matrix.py, the `slow` test at the bottom); everything
+else runs in-process via the deterministic fault hooks."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu import cv_train, faults
+from commefficient_tpu.checkpoint import (CheckpointIntegrityError,
+                                          CheckpointManager, load_state,
+                                          save_state)
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.core import FedRuntime, RoundPipeline
+from commefficient_tpu.core.preempt import (PreemptGuard, RoundWatchdog,
+                                            collect_ledger_state,
+                                            restore_ledger_state,
+                                            stall_deadline_s, with_retries)
+from commefficient_tpu.core.quarantine import QuarantineLedger
+from commefficient_tpu.data.fed_sampler import FedSampler
+from commefficient_tpu.telemetry import RunTelemetry, validate_file
+from commefficient_tpu.telemetry.clients import ParticipationLedger
+from commefficient_tpu.telemetry.health import AnomalyMonitor
+from commefficient_tpu.telemetry.schema import validate_event
+from commefficient_tpu.utils import TableLogger
+from tests.test_telemetry import read_events
+
+W, B, D_IN, D_OUT = 4, 2, 6, 3
+
+
+def quad_loss(params, batch, mask):
+    pred = batch["x"] @ params["w"]
+    m = mask.astype(jnp.float32)
+    denom = jnp.maximum(m.sum(), 1.0)
+    err = ((pred - batch["y"]) ** 2).sum(axis=1)
+    loss = (err * m).sum() / denom
+    return loss, (loss,)
+
+
+class FaultDS:
+    """8 clients x 8 items (W=4, B=2 => 8 rounds/epoch), INDEX-keyed
+    data: a resumed run gathers the exact same per-item rows the
+    uninterrupted run would have — the bitwise-resume assertions ride
+    on this."""
+
+    data_per_client = np.full(8, 8)
+    num_clients = 8
+    _rng = np.random.RandomState(0)
+    _x = _rng.randn(256, D_IN).astype(np.float32)
+    _y = _rng.randn(256, D_OUT).astype(np.float32)
+
+    def __len__(self):
+        return 64
+
+    def gather(self, idx):
+        idx = np.asarray(idx)
+        return {"x": self._x[idx], "y": self._y[idx]}
+
+
+def make_rt(**kw):
+    cfg_kw = dict(mode="sketch", error_type="virtual", local_momentum=0.0,
+                  virtual_momentum=0.9, weight_decay=0.0, num_workers=W,
+                  local_batch_size=B, track_bytes=True, num_clients=8,
+                  num_results_train=2, num_results_val=2, k=5, num_rows=2,
+                  num_cols=32, exact_num_cols=True, dataset_name="SYNTH",
+                  telemetry_every=1)
+    cfg_kw.update(kw)
+    params = {"w": jnp.asarray(
+        np.random.RandomState(0).randn(D_IN, D_OUT), jnp.float32)}
+    return FedRuntime(FedConfig(**cfg_kw), params, quad_loss, num_clients=8)
+
+
+def run_driver(tmp, *, resume=False, fault=None, num_epochs=2.0,
+               telemetry=True, **cfg_kw):
+    """One cv_train.train run through the REAL checkpoint/resume wiring
+    (setup_checkpointing + RunTelemetry against a FIXED logdir, so a
+    resumed run appends to its predecessor's stream)."""
+    rt = make_rt(do_resume=resume, checkpoint_every=1,
+                 checkpoint_path=str(tmp / "ck"), **cfg_kw)
+    cfg = rt.cfg.replace(num_epochs=num_epochs, pivot_epoch=1.0)
+    mgr, start_epoch, restored, resume_info = cv_train.setup_checkpointing(
+        cfg, rt, "quad")
+    state = restored if restored is not None else rt.init_state()
+    tel = None
+    if telemetry:
+        tel = RunTelemetry(
+            str(tmp / "logs"), "cv_train", cfg=rt.cfg,
+            resume_info=(None if resume_info is None else
+                         {"round": resume_info["global_round"],
+                          "epoch": start_epoch,
+                          "checkpoint": resume_info["checkpoint"]}))
+        tel.instrument(rt)
+    if fault:
+        faults.set_fault(fault)
+    try:
+        state, summary = cv_train.train(
+            cfg, rt, state, FaultDS(), FaultDS(),
+            loggers=(TableLogger(),), telemetry=tel, ckpt_mgr=mgr,
+            start_epoch=start_epoch, resume_info=resume_info)
+    finally:
+        faults.set_fault(None)
+        if tel is not None:
+            tel.close()
+    return rt, state, summary, mgr, (tel.path if tel else None)
+
+
+def round_losses(path):
+    return {e["round"]: e["loss"] for e in read_events(path)
+            if e["event"] == "round"}
+
+
+# ----------------------------------------------------- preemption guard
+
+
+def test_preempt_guard_first_signal_flags_second_forces():
+    exits = []
+    guard = PreemptGuard(grace_s=5.0, _exit=exits.append)
+    old = signal.getsignal(signal.SIGTERM)
+    guard.install()
+    try:
+        assert guard.installed and not guard.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.requested and guard.signal_name == "SIGTERM"
+        assert guard.grace_used_s() is not None
+        assert not exits
+        os.kill(os.getpid(), signal.SIGTERM)   # second: force-exit path
+        assert exits == [128 + int(signal.SIGTERM)]
+    finally:
+        guard.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is old
+
+
+def test_preempt_guard_rejects_nonpositive_grace():
+    with pytest.raises(ValueError, match="grace"):
+        PreemptGuard(grace_s=0.0)
+
+
+def test_grace_budget_is_enforced():
+    """force_exit_after is the drain's hard ceiling: it fires when the
+    drain wedges past the remaining budget and is cancelled on a
+    successful drain."""
+    exits = []
+    guard = PreemptGuard(grace_s=1.0, _exit=exits.append)
+    t = guard.force_exit_after(0.02)
+    time.sleep(0.2)
+    assert exits == [1]
+    exits.clear()
+    t2 = guard.force_exit_after(5.0)
+    t2.cancel()                       # the successful-drain path
+    time.sleep(0.05)
+    assert exits == []
+
+
+def test_config_validates_preempt_and_watchdog():
+    with pytest.raises(ValueError, match="preempt_grace"):
+        FedConfig(preempt_grace=0.0)
+    with pytest.raises(ValueError, match="preempt_grace"):
+        FedConfig(preempt_grace=-3.0)
+    with pytest.raises(ValueError, match="watchdog_mult"):
+        FedConfig(watchdog_mult=0.5)
+    FedConfig(watchdog_mult=1.0, preempt_grace=0.1)   # boundaries legal
+    # a watchdog without telemetry records could never arm — the
+    # silently-ignored-flag contract rejects the combination
+    with pytest.raises(ValueError, match="--watchdog requires"):
+        FedConfig(watchdog=True, telemetry=False)
+    with pytest.raises(ValueError, match="--watchdog requires"):
+        FedConfig(watchdog=True, telemetry_every=0)
+    FedConfig(watchdog=True)                          # default cadence ok
+
+
+def test_flight_recorder_state_upgrades_events_only_bundle(tmp_path):
+    """A watchdog stall's events-only bundle must not consume the
+    one-shot slot for STATE: a later NaN-abort record(state, ...) adds
+    state.npz to the existing bundle instead of being swallowed."""
+    from commefficient_tpu.telemetry.health import FlightRecorder
+    rt = make_rt()
+    rec = FlightRecorder(str(tmp_path))
+    out = rec.record(None, {"rule": "round_stall", "round": 3})
+    assert out is not None
+    assert not os.path.exists(os.path.join(out, "state.npz"))
+    out2 = rec.record(rt.init_state(), {"rule": "nonfinite_abort",
+                                        "round": 9})
+    assert out2 == out
+    assert os.path.exists(os.path.join(out, "state.npz"))
+    # still one-shot for further state records
+    meta_before = open(os.path.join(out, "state.meta.json")).read()
+    rec.record(rt.init_state(), {"rule": "later", "round": 10})
+    assert open(os.path.join(out, "state.meta.json")).read() == meta_before
+
+
+# ------------------------------------------------- graceful drain path
+
+
+def test_sigterm_drains_with_round_granular_checkpoint(tmp_path, capsys):
+    """The graceful path end to end: a SIGTERM injected at round 5 lets
+    round 5 finish, drains at the top of round 6, writes a
+    preempt-tagged checkpoint carrying (epoch, round_in_epoch,
+    global_round) + the ledger sidecar, emits the `fault` event, and
+    returns as an orderly (state, None) exit."""
+    rt, state, summary, mgr, stream = run_driver(
+        tmp_path, fault="sigterm:pre_round:5", num_epochs=1.0)
+    assert summary is None
+    out = capsys.readouterr()
+    assert "PREEMPT: SIGTERM received" in out.err
+    assert "PREEMPT: drained at epoch 0 + 5 round(s)" in out.out
+    gens = mgr.generations()
+    assert gens and gens[-1][2].endswith("_preempt")
+    assert gens[-1][:2] == (0, 5)
+    from commefficient_tpu.checkpoint import load_meta
+    meta = load_meta(os.path.join(mgr.directory, gens[-1][2]))
+    assert meta["epoch"] == 0 and meta["round_in_epoch"] == 5
+    assert meta["global_round"] == 5 and meta["tag"] == "preempt"
+    assert meta["ledgers"] is not None and "digests" in meta
+    events = read_events(stream)
+    faults_ev = [e for e in events if e["event"] == "fault"]
+    assert len(faults_ev) == 1
+    f = faults_ev[0]
+    assert f["kind"] == "preempt" and f["signal"] == "SIGTERM"
+    assert f["round"] == 5 and f["grace_s"] is not None
+    assert f["checkpoint"] and "_preempt" in f["checkpoint"]
+    assert events[-1]["event"] == "summary" and events[-1]["aborted"]
+    assert validate_file(stream) == []
+    # exactly 5 rounds trained before the drain
+    assert sorted(round_losses(stream)) == [1, 2, 3, 4, 5]
+
+
+def test_kill_at_round_k_resume_is_bitwise_identical(tmp_path):
+    """THE acceptance property: straight N rounds == preempt-at-5 +
+    resume, bit for bit — per-round losses and the final weights. The
+    resumed stream appends to the predecessor's with a `resume`
+    lineage record and stays schema-valid end to end."""
+    straight_dir = tmp_path / "straight"
+    straight_dir.mkdir()
+    rt_a, state_a, summary_a, _, stream_a = run_driver(
+        straight_dir, num_epochs=2.0)
+    assert summary_a is not None
+    losses_a = round_losses(stream_a)
+    # epoch_rounds() is an upper bound: the sampler may strand an
+    # underfull tail, so pin only "two epochs of contiguous rounds"
+    assert sorted(losses_a) == list(range(1, len(losses_a) + 1))
+    assert len(losses_a) >= 10
+
+    killed_dir = tmp_path / "killed"
+    killed_dir.mkdir()
+    _, _, summary_b, _, _ = run_driver(
+        killed_dir, fault="sigterm:pre_round:5", num_epochs=2.0)
+    assert summary_b is None
+    rt_c, state_c, summary_c, _, stream_c = run_driver(
+        killed_dir, resume=True, num_epochs=2.0)
+    assert summary_c is not None
+
+    losses_c = round_losses(stream_c)
+    assert losses_c == losses_a, "resumed trajectory diverged"
+    np.testing.assert_array_equal(
+        np.asarray(rt_a.flat_weights(state_a)),
+        np.asarray(rt_c.flat_weights(state_c)))
+    events = read_events(stream_c)
+    kinds = [e["event"] for e in events]
+    resumes = [e for e in events if e["event"] == "resume"]
+    assert resumes, "no resume lineage record"
+    assert resumes[0]["round"] == 5
+    assert resumes[0]["checkpoint"] and "_preempt" in resumes[0]["checkpoint"]
+    assert resumes[0]["prior_stream"]          # names the dead segment
+    assert kinds.count("manifest") == 2        # two segments, one file
+    assert validate_file(stream_c) == []
+
+
+def test_resume_from_epoch_checkpoint_unchanged_semantics(tmp_path):
+    """Epoch-granular resume (the pre-existing path) still works through
+    the new meta: kill between epochs via a full epoch-1 run, resume
+    completes epoch 2 bit-identically to the straight run."""
+    straight_dir = tmp_path / "s"
+    straight_dir.mkdir()
+    rt_a, state_a, _, _, stream_a = run_driver(straight_dir,
+                                               num_epochs=2.0)
+    part_dir = tmp_path / "p"
+    part_dir.mkdir()
+    run_driver(part_dir, num_epochs=1.0)
+    rt_b, state_b, summary_b, _, stream_b = run_driver(
+        part_dir, resume=True, num_epochs=2.0)
+    assert summary_b is not None
+    assert round_losses(stream_b) == round_losses(stream_a)
+    np.testing.assert_array_equal(
+        np.asarray(rt_a.flat_weights(state_a)),
+        np.asarray(rt_b.flat_weights(state_b)))
+
+
+# -------------------------------------------- quarantine persistence
+
+
+def test_quarantine_survives_resume(tmp_path):
+    """Satellite: eject a client, resume, assert STILL ejected — an
+    epoch-granular restart must not silently re-admit known-bad clients
+    (they used to re-strike from zero)."""
+    kw = dict(adversary="nan", adversary_frac=0.3, seed=21,
+              nonfinite_action="quarantine", quarantine_backoff=50,
+              quarantine_strikes=1)
+    rt, state, summary, mgr, stream = run_driver(tmp_path, num_epochs=1.0,
+                                                 **kw)
+    assert summary is not None
+    events = read_events(stream)
+    ejected = max(e.get("ejected", 0) for e in events
+                  if e["event"] == "defense")
+    assert ejected >= 1, "no ejection happened in epoch 1 — bad seed?"
+    # the epoch-cadence checkpoint carried the ledger sidecar
+    from commefficient_tpu.checkpoint import load_meta
+    meta = load_meta(os.path.join(mgr.directory, mgr.generations()[-1][2]))
+    assert meta["ledgers"]["quarantine"]["ejected"], meta["ledgers"]
+
+    rt2, state2, summary2, _, stream2 = run_driver(
+        tmp_path, resume=True, num_epochs=2.0, **kw)
+    assert summary2 is not None
+    seg2 = [e for e in read_events(stream2)
+            if e["event"] == "defense" and e["round"] > 8]
+    assert seg2, "resumed epoch emitted no defense events"
+    # ejected from the FIRST resumed round: the ledger restored, the
+    # client never re-admitted
+    assert all(e["ejected"] >= ejected for e in seg2), seg2[:3]
+
+
+def test_ledger_state_roundtrips():
+    q = QuarantineLedger(backoff=3, strikes=2)
+    q.observe(1, [4, 5], [True, False])
+    q.observe(5, [5], [False])             # 5 ejected
+    p = ParticipationLedger(8)
+    p.observe(1, [0, 1], [2, 3])
+    p.observe(4, [1, 2], [1, 1])
+    m = AnomalyMonitor(None, window=8)
+    for i in range(10):
+        m.observe("round", {"round": i, "loss": 1.0 + 0.01 * i})
+    sidecar = collect_ledger_state(qledger=q, participation=p, monitor=m)
+    sidecar = json.loads(json.dumps(sidecar))   # must survive JSON
+    q2, p2 = QuarantineLedger(backoff=3, strikes=2), ParticipationLedger(8)
+    m2 = AnomalyMonitor(None, window=8)
+    restore_ledger_state(sidecar, qledger=q2, participation=p2, monitor=m2)
+    assert q2.ejected == {5} and q2.blocked(100) == {5}
+    assert q2.strikes == q.strikes and q2.total_strikes == 2
+    assert p2.snapshot(6) == p.snapshot(6)
+    assert m2.state_dict() == m.state_dict()
+    # a restored monitor KEEPS its envelope: the next spike fires
+    # without re-warming min_points of history
+    fired = m2.observe("round", {"round": 7, "loss": 500.0})
+    assert any(a["rule"] == "loss_spike" for a in fired)
+    # absent/partial sidecars are no-ops
+    restore_ledger_state(None, qledger=q2)
+    restore_ledger_state({}, qledger=q2)
+
+
+# ------------------------------------------ checkpoint integrity
+
+
+def _two_gen_mgr(tmp_path):
+    rt = make_rt()
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    s = rt.init_state()
+    mgr.save(s, epoch=1, meta={"mark": "gen1"})
+    # the round DONATES s's buffers: keep host copies for comparisons
+    w1 = np.asarray(s.ps_weights).copy()
+    batch = {"x": jnp.ones((W, B, D_IN)), "y": jnp.ones((W, B, D_OUT))}
+    s2, _ = rt.round(s, jnp.arange(W, dtype=jnp.int32), batch,
+                     jnp.ones((W, B), bool), 0.05)
+    mgr.save(s2, epoch=2, meta={"mark": "gen2"})
+    return rt, mgr, w1, s2
+
+
+def test_truncated_zip_falls_back_a_generation(tmp_path, capsys):
+    rt, mgr, w1, s2 = _two_gen_mgr(tmp_path)
+    npz = mgr._path(2) + ".npz"
+    raw = open(npz, "rb").read()
+    with open(npz, "wb") as f:
+        f.write(raw[: len(raw) // 2])      # kill mid-write, no rename
+    restored, meta = mgr.restore_latest()
+    assert meta["mark"] == "gen1"
+    np.testing.assert_array_equal(np.asarray(restored.ps_weights), w1)
+    err = capsys.readouterr().err
+    assert "unreadable or corrupt" in err
+    assert "falling back to the previous generation" in err
+    assert len(mgr.restore_fallbacks) == 1
+    assert mgr.restore_fallbacks[0]["path"] == mgr._path(2)
+
+
+def test_bitflip_caught_by_digest_falls_back(tmp_path, capsys):
+    """A corrupted array REWRITTEN through np.savez (valid zip, valid
+    CRC — only the sha256 digests in the meta sidecar can notice)
+    still falls back with the digest explanation."""
+    rt, mgr, w1, s2 = _two_gen_mgr(tmp_path)
+    npz = mgr._path(2) + ".npz"
+    with np.load(npz) as z:
+        arrays = {k: np.array(z[k]) for k in z.files}
+    arrays["ps_weights"] = arrays["ps_weights"] + 1.0   # silent corruption
+    with open(npz, "wb") as f:
+        np.savez(f, **arrays)
+    restored, meta = mgr.restore_latest()
+    assert meta["mark"] == "gen1"
+    assert "sha256 digest" in capsys.readouterr().err
+    # direct load pins the error class + wording
+    gen2_meta = json.load(open(mgr._path(2) + ".meta.json"))
+    with pytest.raises(CheckpointIntegrityError, match="sha256 digest"):
+        load_state(mgr._path(2), verify_digests=gen2_meta["digests"])
+
+
+def test_all_generations_corrupt_raises_loudly(tmp_path):
+    rt, mgr, _, _ = _two_gen_mgr(tmp_path)
+    for e in (1, 2):
+        with open(mgr._path(e) + ".npz", "wb") as f:
+            f.write(b"junk")
+    with pytest.raises(CheckpointIntegrityError,
+                       match="every checkpoint generation"):
+        mgr.restore_latest()
+    assert len(mgr.restore_fallbacks) == 2
+
+
+def test_corrupt_meta_sidecar_falls_back(tmp_path):
+    rt, mgr, w1, _ = _two_gen_mgr(tmp_path)
+    with open(mgr._path(2) + ".meta.json", "w") as f:
+        f.write("{truncated")
+    restored, meta = mgr.restore_latest()
+    assert meta["mark"] == "gen1"
+
+
+def test_semantic_refusals_do_not_fall_back(tmp_path):
+    """A fingerprint/marker mismatch is a CONFIG error — falling back a
+    generation cannot fix it and must not mask it."""
+    rt, mgr, _, _ = _two_gen_mgr(tmp_path)
+    # stamp a fingerprint into gen2's meta, then expect a different one
+    meta = json.load(open(mgr._path(2) + ".meta.json"))
+    meta["params_fingerprint"] = "aaaa"
+    json.dump(meta, open(mgr._path(2) + ".meta.json", "w"))
+    mgr2 = CheckpointManager(mgr.directory)
+    with pytest.raises(ValueError, match="different parameter layout"):
+        mgr2.restore_latest(expect_fingerprint="bbbb")
+    assert not mgr2.restore_fallbacks
+
+
+def test_sharded_checkpoint_digests_roundtrip(tmp_path):
+    """The streaming (sharded) writer records per-ENTRY digests and the
+    host reassembly path verifies them."""
+    rt = make_rt()
+    s = rt.init_state()
+    path = str(tmp_path / "sh")
+    save_state(path, s, sharded=True)
+    meta = json.load(open(path + ".meta.json"))
+    assert any(k.endswith("__shard0") for k in meta["digests"])
+    loaded = load_state(path, verify_digests=meta["digests"])
+    np.testing.assert_array_equal(np.asarray(loaded.ps_weights),
+                                  np.asarray(s.ps_weights))
+    # flip one shard entry by rewriting the archive
+    import zipfile
+    with np.load(path + ".npz") as z:
+        entries = {k: np.array(z[k]) for k in z.files}
+    entries["ps_weights__shard0"] = entries["ps_weights__shard0"] * 2
+    with zipfile.ZipFile(path + ".npz", "w", zipfile.ZIP_STORED) as zf:
+        for k, arr in entries.items():
+            with zf.open(k + ".npy", "w") as f:
+                np.lib.format.write_array(f, arr, allow_pickle=False)
+    with pytest.raises(CheckpointIntegrityError, match="sha256 digest"):
+        load_state(path, verify_digests=meta["digests"])
+
+
+def test_stale_tmp_cleanup_on_save(tmp_path):
+    rt = make_rt()
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    os.makedirs(mgr.directory, exist_ok=True)
+    litter = os.path.join(mgr.directory, "xyz123.tmp")
+    open(litter, "w").write("leftover from a kill mid-write")
+    removed = mgr.clean_stale_tmp()
+    assert removed == [litter] and not os.path.exists(litter)
+    open(litter, "w").write("again")
+    mgr.save(rt.init_state(), epoch=1)     # save() self-heals too
+    assert not os.path.exists(litter)
+    assert not [fn for fn in os.listdir(mgr.directory)
+                if fn.endswith(".tmp")]
+
+
+def test_preempt_generation_ordering_and_rotation(tmp_path):
+    rt = make_rt()
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep_last=3)
+    s = rt.init_state()
+    mgr.save(s, epoch=1)
+    mgr.save(s, epoch=1, round_in_epoch=5, tag="preempt")
+    mgr.save(s, epoch=2)
+    assert [(e, r) for e, r, _ in mgr.generations()] == \
+        [(1, 0), (1, 5), (2, 0)]
+    assert mgr.epochs() == [1, 2]          # back-compat surface
+    # newest preempt generation wins the restore
+    mgr.save(s, epoch=2, round_in_epoch=3, tag="preempt")
+    _, meta = mgr.restore_latest()
+    assert meta["epoch"] == 2 and meta["round_in_epoch"] == 3
+    # rotation spans BOTH kinds (keep_last=3 of 4)
+    assert len(mgr.generations()) == 3
+    assert (1, 0) not in [(e, r) for e, r, _ in mgr.generations()]
+
+
+# ------------------------------------------- telemetry append-resume
+
+
+def test_stream_append_preserves_prior_records(tmp_path):
+    """Satellite: RunTelemetry must NEVER open an existing events file
+    with "w" — the resumed run appends behind a `resume` marker and the
+    predecessor's records survive."""
+    a = RunTelemetry(str(tmp_path), "cv_train", cfg=make_rt().cfg)
+    a.round_event(rnd=1, epoch=1, lr=0.1, loss=1.5, acc=0.5, n_valid=8.0,
+                  download_bytes=None, upload_bytes=None, host_s=0.0,
+                  dispatch_s=0.0, device_s=0.0)
+    a_id = a.stream_id
+    a.close()
+    a_events = read_events(a.path)
+    n_before = len(a_events)
+
+    b = RunTelemetry(str(tmp_path), "cv_train", cfg=make_rt().cfg,
+                     resume_info={"round": 2, "epoch": 0,
+                                  "checkpoint": "ck/x"})
+    b.round_event(rnd=2, epoch=1, lr=0.1, loss=1.4, acc=0.5, n_valid=8.0,
+                  download_bytes=None, upload_bytes=None, host_s=0.0,
+                  dispatch_s=0.0, device_s=0.0)
+    b.write_summary(aborted=False, n_rounds=2)
+    b.close()
+    events = read_events(b.path)
+    assert len(events) > n_before
+    assert events[: n_before] == a_events   # predecessor records intact
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "manifest" and kinds.count("manifest") == 2
+    res = events[n_before]
+    assert res["event"] == "resume"
+    assert res["prior_stream"] == a_id
+    assert res["prior_events"] == n_before
+    assert res["round"] == 2 and res["checkpoint"] == "ck/x"
+    assert [e["seq"] for e in events] == list(range(len(events)))
+    assert validate_file(b.path) == []
+
+
+def test_stream_append_repairs_truncated_tail(tmp_path):
+    a = RunTelemetry(str(tmp_path), "cv_train", cfg=make_rt().cfg)
+    a.close()
+    with open(a.path, "a") as f:
+        f.write('{"event": "round", "t": 1.0, "se')   # died mid-write
+    b = RunTelemetry(str(tmp_path), "cv_train", cfg=make_rt().cfg)
+    b.close()
+    lines = open(b.path).read().splitlines()
+    # the fragment occupies its own (invalid) line; everything after
+    # parses — teleview reads it, the schema linter flags exactly one
+    parsed = []
+    for ln in lines:
+        try:
+            parsed.append(json.loads(ln))
+        except ValueError:
+            parsed.append(None)
+    assert parsed.count(None) == 1
+    assert parsed[-1]["event"] == "manifest"
+    assert parsed[-2]["event"] == "resume"
+
+
+def test_fresh_logdir_resume_records_lineage(tmp_path):
+    tel = RunTelemetry(str(tmp_path), "cv_train", cfg=make_rt().cfg,
+                       resume_info={"round": 9, "epoch": 1,
+                                    "checkpoint": "ck/y"})
+    tel.close()
+    events = read_events(tel.path)
+    assert events[0]["event"] == "manifest"
+    assert events[0]["stream_id"]
+    res = [e for e in events if e["event"] == "resume"]
+    assert len(res) == 1 and res[0]["round"] == 9
+    assert res[0]["prior_stream"] is None   # no predecessor in THIS file
+    assert validate_file(tel.path) == []
+
+
+def test_fault_and_resume_events_validate():
+    ok = {"event": "fault", "t": 0.0, "seq": 0, "round": 5,
+          "kind": "preempt", "signal": "SIGTERM", "grace_s": 1.2,
+          "detail": None, "checkpoint": "ck/..."}
+    assert validate_event(ok) == []
+    assert any("kind" in p for p in validate_event(
+        {k: v for k, v in ok.items() if k != "kind"}))
+    ok2 = {"event": "resume", "t": 0.0, "seq": 1, "round": 5,
+           "epoch": 0, "checkpoint": None, "prior_stream": None,
+           "prior_events": None}
+    assert validate_event(ok2) == []
+    # v7 manifests legitimately lack stream_id; v8 ones may not
+    man = {"event": "manifest", "t": 0.0, "seq": 0, "schema": 7,
+           "run_type": "x", "jax_version": "x", "backend": "cpu",
+           "device_kind": "cpu", "device_count": 1, "mesh_shape": [],
+           "mesh_axes": [], "grad_size": 0, "sketch": None, "config": {}}
+    assert validate_event(man, version=7) == []
+    assert any("stream_id" in p for p in validate_event(man, version=8))
+
+
+# ----------------------------------------------------- hang watchdog
+
+
+def test_stall_deadline_math():
+    assert stall_deadline_s([0.1] * 3, 10.0) is None     # too few points
+    d = stall_deadline_s([0.1] * 16, 10.0, floor_s=0.0)
+    # constant history: MAD floored at max(2% of median, 50 ms)
+    assert d == pytest.approx(10.0 * 0.1 + 6 * 0.05)
+    assert stall_deadline_s([0.001] * 16, 1.0, floor_s=2.0) == 2.0
+    assert stall_deadline_s([1.0] * 16, 20.0, floor_s=0.0) > \
+        stall_deadline_s([1.0] * 16, 10.0, floor_s=0.0)
+
+
+def test_watchdog_fires_once_per_stalled_round():
+    fired = []
+    wd = RoundWatchdog(lambda r, el, dl: fired.append((r, el, dl)),
+                       mult=1.0, floor_s=0.05, poll_s=0.005)
+    try:
+        for _ in range(6):                    # warm the history: ~1 ms
+            wd.arm(0)
+            time.sleep(0.001)
+            wd.disarm()
+        wd.arm(7)
+        time.sleep(0.4)                       # well past the deadline
+        assert len(fired) == 1, fired         # once, not once per poll
+        assert fired[0][0] == 7 and fired[0][1] >= fired[0][2]
+        wd.disarm()
+        wd.arm(8)                             # healthy round: no fire
+        wd.disarm()
+        time.sleep(0.05)
+        assert len(fired) == 1
+    finally:
+        wd.close()
+    assert not any(t.name == "round-watchdog" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_watchdog_rejects_sub_one_mult():
+    with pytest.raises(ValueError, match="mult"):
+        RoundWatchdog(lambda *a: None, mult=0.9)
+
+
+def test_watchdog_unobserved_disarm_keeps_history_clean():
+    """Dispatch-only (non-record) rounds must not feed the deadline
+    history: a bimodal fast/slow mix would collapse the median onto the
+    async-dispatch mode and false-fire on healthy synced rounds."""
+    wd = RoundWatchdog(lambda *a: None, mult=2.0, floor_s=0.01)
+    try:
+        for _ in range(5):
+            wd.arm(1)
+            wd.disarm(observe=False)   # async dispatch, never synced
+        assert len(wd.history) == 0 and wd.deadline_s() is None
+        for _ in range(5):
+            wd.arm(2)
+            wd.disarm()                # synced round: observed
+        assert len(wd.history) == 5 and wd.deadline_s() is not None
+    finally:
+        wd.close()
+
+
+def test_with_retries_backoff_and_exhaustion():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    notes = []
+    assert with_retries(flaky, attempts=3, base_s=0.001,
+                        on_retry=lambda a, e: notes.append(a)) == "ok"
+    assert len(calls) == 3 and notes == [1, 2]
+
+    def always():
+        raise OSError("dead")
+
+    with pytest.raises(OSError, match="dead"):
+        with_retries(always, attempts=2, base_s=0.001)
+    with pytest.raises(ValueError, match="attempts"):
+        with_retries(lambda: 1, attempts=0)
+
+
+def test_driver_watchdog_on_is_bit_identical_and_leak_free(tmp_path):
+    """--watchdog must observe, never perturb: same losses with it on,
+    zero stalls on a healthy run, no leaked thread after train."""
+    a_dir, b_dir = tmp_path / "a", tmp_path / "b"
+    a_dir.mkdir(), b_dir.mkdir()
+    _, _, _, _, stream_a = run_driver(a_dir, num_epochs=1.0)
+    _, _, summary_b, _, stream_b = run_driver(b_dir, num_epochs=1.0,
+                                              watchdog=True)
+    assert summary_b is not None
+    assert round_losses(stream_b) == round_losses(stream_a)
+    assert not [e for e in read_events(stream_b)
+                if e["event"] == "fault"]
+    assert not any(t.name == "round-watchdog" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+# ------------------------------------------------ fault spec plumbing
+
+
+def test_fault_spec_parsing_and_matching():
+    faults.set_fault(None)
+    assert not faults.faults_enabled()
+    faults.maybe_fault("pre_round", 1)          # disarmed: no-op
+    faults.set_fault("kill:pre_round:5")
+    assert faults.faults_enabled()
+    assert not faults.fault_matches("pre_round", 4)
+    assert not faults.fault_matches("mid_round", 5)
+    assert faults.fault_matches("pre_round", 5)
+    faults.set_fault("kill:mid_checkpoint_write")
+    assert faults.fault_matches("mid_checkpoint_write")   # first visit
+    faults.set_fault(None)
+    for bad in ("nope", "kill:bogus_point", "sigsegv:pre_round",
+                "kill:pre_round:5:9"):
+        with pytest.raises(ValueError):
+            faults.set_fault(bad)
+    faults.set_fault(None)
+
+
+def test_pipeline_skip_replays_sampler_tail():
+    """RoundPipeline(skip=k) yields exactly the unskipped run's rounds
+    k+1.. with identical sampler draws and global numbering — the
+    round-granular resume primitive."""
+    def rounds():
+        return FedSampler(np.full(8, 16), W, B, seed=1234)
+
+    full = list(RoundPipeline(iter(rounds()), lambda r, g: g,
+                              start_round=0, enabled=False))
+    skipped = list(RoundPipeline(iter(rounds()), lambda r, g: g,
+                                 start_round=0, enabled=False, skip=3))
+    assert len(skipped) == len(full) - 3
+    for a, b in zip(full[3:], skipped):
+        assert a.global_round == b.global_round
+        np.testing.assert_array_equal(a.rnd.client_ids, b.rnd.client_ids)
+        np.testing.assert_array_equal(a.rnd.idx, b.rnd.idx)
+    # threaded path too
+    threaded = list(RoundPipeline(iter(rounds()), lambda r, g: g,
+                                  start_round=0, enabled=True, skip=3))
+    assert [t.global_round for t in threaded] == \
+        [s.global_round for s in skipped]
+    with pytest.raises(ValueError, match="skip"):
+        RoundPipeline(iter(()), lambda r, g: g, start_round=0, skip=-1)
+
+
+# ----------------------------------------------- teleview stitching
+
+
+def _load_teleview():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "teleview", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "teleview.py"))
+    tv = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tv)
+    return tv
+
+
+def test_teleview_stitches_lineage_segments(tmp_path, capsys):
+    """Satellite: `teleview summarize` reports the stitched segments,
+    resume points and faults of an appended stream; `timeline` and
+    `alerts` tolerate the new event types (a graceful preempt must NOT
+    trip the alerts health gate)."""
+    straight = tmp_path
+    _, _, _, _, stream = run_driver(straight,
+                                    fault="sigterm:pre_round:5",
+                                    num_epochs=2.0)
+    run_driver(straight, resume=True, num_epochs=2.0)
+    tv = _load_teleview()
+    events = tv.load_events(stream)
+    capsys.readouterr()
+    tv.summarize(events, label="stitched")
+    out = capsys.readouterr().out
+    assert "lineage: 2 segments" in out
+    assert "resume at round 5" in out and "continues segment" in out
+    assert "fault [preempt]" in out and "SIGTERM" in out
+    # alerts: fault records are listed as context but never change the
+    # health-gate verdict (a graceful preempt is not a failure — only
+    # genuine critical ALERTS/aborts trip the gate, same verdict with
+    # the fault events stripped)
+    rc_with = tv.alerts(events)
+    out = capsys.readouterr().out
+    assert "preempt" in out
+    rc_without = tv.alerts([e for e in events
+                            if e.get("event") != "fault"])
+    capsys.readouterr()
+    assert rc_with == rc_without
+    # timeline: the stitched stream still renders a trace
+    trace = tv.build_trace(events)
+    assert trace["traceEvents"]
+
+
+# ------------------------------------------------ subprocess matrix
+
+
+@pytest.mark.slow
+def test_crash_matrix_hard_kill_subprocess():
+    """One REAL os._exit(137) kill-point through the subprocess harness
+    (scripts/crash_matrix.py): finally-blocks skipped, .tmp litter
+    possible, stream truncated — and the resume still reproduces the
+    straight run bit for bit. The full matrix runs standalone."""
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "crash_matrix.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, script, "--points",
+         "pre_round,mid_checkpoint_write"],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "RESULT pre_round: PASS" in proc.stdout
+    assert "RESULT mid_checkpoint_write: PASS" in proc.stdout

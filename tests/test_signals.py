@@ -100,13 +100,14 @@ def test_resume_rezeros_missing_shadow(tmp_path):
                          checkpoint_path=str(tmp_path))
     batch, mask, ids = make_batch()
     state, _ = plain.round(plain.init_state(), ids, batch, mask, 0.05)
-    mgr, _, _ = setup_checkpointing(plain.cfg, plain, "quad")
+    mgr, _, _, _ = setup_checkpointing(plain.cfg, plain, "quad")
     mgr.save(state, 1)
     exact = make_runtime(do_resume=True, checkpoint_every=1,
                          checkpoint_path=str(tmp_path),
                          signals_exact=True)
     assert exact._signals_shadow
-    _, start, restored = setup_checkpointing(exact.cfg, exact, "quad")
+    _, start, restored, _ = setup_checkpointing(exact.cfg, exact,
+                                                 "quad")
     assert start == 1 and restored is not None
     assert restored.sig_Verror is not None
     np.testing.assert_array_equal(np.asarray(restored.sig_Verror),
