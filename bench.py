@@ -41,7 +41,7 @@ NOMINAL_SINGLE_GPU_IMG_PER_SEC = 2000.0
 
 def run_cifar(result: dict, W: int = 8, B: int = 64,
               n_rounds: int = 20, telemetry=None, profiler=None,
-              compile_cache=None) -> None:
+              compile_cache=None, wire_dtype: str = "float32") -> None:
     """Fill ``result`` in place so partial progress survives a crash.
 
     Default (W=8, B=64) is the flagship-parity round shape — 512
@@ -67,8 +67,10 @@ def run_cifar(result: dict, W: int = 8, B: int = 64,
         # TPU-tuned select: approx_max_k (0.95 recall) for the top-k
         # sparsification — itself an approximation — instead of a 20x
         # slower exact sort-based select. Sketch: the default circulant
-        # impl (fp32 tables).
+        # impl (fp32 tables); --wire_dtype selects the table wire
+        # (f32 / bf16 / int8-quantized — ops/wire.py).
         approx_topk=True,
+        wire_dtype=wire_dtype,
     )
     # persistent compile cache: retried compiles and the cost-analysis
     # lower+compile after the timing loop become near-free; --compile_cache
@@ -112,6 +114,13 @@ def run_cifar(result: dict, W: int = 8, B: int = 64,
     result["value"] = round(ips, 1)
     result["vs_baseline"] = round(ips / NOMINAL_SINGLE_GPU_IMG_PER_SEC, 3)
     result["timed_rounds"] = n_rounds
+    # quantized-wire arm identity (schema v9 / ISSUE 14): which table
+    # wire this arm ran, and the exact simulated per-round upload
+    # payload (W clients x the wire-dtype cell cost incl. int8 scales)
+    # — what lets BENCH_r* trajectory files distinguish wire arms
+    result["wire_dtype"] = cfg.wire_dtype
+    result["wire_bytes_per_round"] = W * cfg.upload_wire_bytes(
+        runtime._wire_block or None)
     # compile+warmup wall seconds BEFORE the timed window — the number
     # --compile_cache exists to shrink (cold ~77 s for this driver run,
     # warm-start target < 10 s); tracked in the BENCH trajectory
@@ -167,7 +176,8 @@ def run_cifar(result: dict, W: int = 8, B: int = 64,
             k: ufields[k] for k in ("bytes_per_round",
                                     "arithmetic_intensity", "bound",
                                     "bw_frac")}
-        telemetry.bench_event(result["metric"], result)
+        telemetry.bench_event(result["metric"], result,
+                              wire_dtype=cfg.wire_dtype)
 
 
 def make_bench_telemetry(args, run_type: str):
@@ -203,6 +213,13 @@ def add_bench_args(ap: argparse.ArgumentParser) -> None:
                          "pass an empty string to DISABLE and measure a "
                          "true cold start); warm starts skip the cold "
                          "compile tax recorded as warmup_s in the JSON")
+    ap.add_argument("--wire_dtype",
+                    choices=("float32", "bfloat16", "int8"),
+                    default="float32",
+                    help="sketch-table wire dtype for the benched round "
+                         "(int8 = block-quantized wire, ops/wire.py); "
+                         "recorded in the headline JSON so BENCH "
+                         "trajectory arms stay distinguishable")
 
 
 def main(argv=None):
@@ -219,7 +236,8 @@ def main(argv=None):
     }
     try:
         run_cifar(result, telemetry=telemetry, profiler=profiler,
-                  compile_cache=args.compile_cache)
+                  compile_cache=args.compile_cache,
+                  wire_dtype=args.wire_dtype)
     except Exception as e:
         log(traceback.format_exc())
         result["error"] = f"{type(e).__name__}: {e}"
@@ -240,7 +258,8 @@ def main(argv=None):
                "value": None, "unit": "images/sec", "vs_baseline": None,
                "mfu": None, "round_images": 32 * 512}
         run_cifar(sat, W=32, B=512, n_rounds=10, telemetry=telemetry,
-                  compile_cache=args.compile_cache)
+                  compile_cache=args.compile_cache,
+                  wire_dtype=args.wire_dtype)
         result["cifar_saturated"] = sat
         log("saturated:", json.dumps(sat))
     except Exception as e:
@@ -254,7 +273,8 @@ def main(argv=None):
     try:
         import bench_gpt2
         result["gpt2"] = bench_gpt2.run(telemetry=telemetry,
-                                        compile_cache=args.compile_cache)
+                                        compile_cache=args.compile_cache,
+                                        wire_dtype=args.wire_dtype)
     except Exception as e:
         log(traceback.format_exc())
         log(f"WARNING: GPT-2 bench failed ({e})")

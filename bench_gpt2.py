@@ -51,7 +51,7 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
         remat_policy: str = "", microbatch: int = 8, lm_chunk: int = 128,
         fused_encode: str = "auto", decode_overlap: bool = False,
         n_rounds: int = 8, compile_cache=None,
-        dryrun: bool = False) -> dict:
+        wire_dtype: str = "float32", dryrun: bool = False) -> dict:
     """Build, warm up and time the GPT-2 round; returns the result dict.
 
     ``remat=True`` is the shipping configuration. remat=False spends the
@@ -143,7 +143,8 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
                     num_clients=100, track_bytes=False, approx_topk=True,
                     num_results_train=2, lm_chunk=lm_chunk,
                     sketch_fused_encode=fused_encode,
-                    decode_overlap=decode_overlap, **sketch_kw)
+                    decode_overlap=decode_overlap,
+                    wire_dtype=wire_dtype, **sketch_kw)
     if compile_cache is not None:  # "" = disable (true cold start)
         cfg = cfg.replace(compilation_cache_dir=compile_cache)
     enable_compilation_cache(cfg)
@@ -261,6 +262,11 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
         "mfu": round(mfu, 4) if np.isfinite(mfu) else None,
         "tokens_per_round": W * B * NC * S,
         "timed_rounds": n_rounds,
+        # quantized-wire arm identity (schema v9 / ISSUE 14): the table
+        # wire dtype and the exact per-round simulated upload payload
+        "wire_dtype": cfg.wire_dtype,
+        "wire_bytes_per_round": W * cfg.upload_wire_bytes(
+            runtime._wire_block or None),
         "warmup_s": warmup_s,
         "phase_split": phases,
         "input_wait_frac": round(phases["host_s"] / dt, 6),
@@ -286,7 +292,8 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
             device_kind=getattr(jax.devices()[0], "device_kind", "unknown"),
             bytes_per_round=(float(nbytes) if nbytes else None),
             bytes_source="cost_analysis")
-        telemetry.bench_event(result["metric"], result)
+        telemetry.bench_event(result["metric"], result,
+                              wire_dtype=cfg.wire_dtype)
     return result
 
 
@@ -398,7 +405,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     telemetry, profiler = make_bench_telemetry(args, "bench_gpt2")
     result = run(telemetry=telemetry, profiler=profiler,
-                 compile_cache=args.compile_cache)
+                 compile_cache=args.compile_cache,
+                 wire_dtype=args.wire_dtype)
     if telemetry is not None:
         telemetry.write_summary(aborted=False,
                                 n_rounds=result["timed_rounds"],

@@ -26,6 +26,10 @@ ALERT_ACTIONS = ("log", "warn", "checkpoint", "abort")
 ADVERSARY_KINDS = ("none", "labelflip", "signflip", "scale", "noise", "nan")
 # robust aggregation in transmitted space (core/server.py)
 DEFENSES = ("none", "normclip", "trim")
+# sketch-table wire dtypes (--wire_dtype; ops/wire.py): what a table
+# cell costs on the ICI/upload wire — f32, bf16 rounding, or int8
+# block-quantized with stochastic rounding
+WIRE_DTYPES = ("float32", "bfloat16", "int8")
 # what the round does with a nonfinite per-client update (core/runtime.py)
 NONFINITE_ACTIONS = ("abort", "quarantine")
 
@@ -193,13 +197,38 @@ class FedConfig:
     # diverges under error feedback; this flag exists to reproduce that
     # study, not to train with
     allow_divergent_rht: bool = False
-    # sketch wire/compute dtype ("float32" | "bfloat16"). For circ/hash:
-    # sketch-table UPLOADS and the multichip table psum travel in bf16 —
-    # half the ICI payload (the reference's NCCL-reduce quantity,
-    # fed_worker.py:138) at ~2^-8 relative cell rounding; server math
-    # stays fp32. For rht it additionally selects the transform compute
-    # dtype (halves the transform's HBM traffic).
+    # DEPRECATED alias of --wire_dtype (kept as a real field: for rht it
+    # still selects the transform compute dtype, and pre-PR-14 configs/
+    # checkpoints name it). __post_init__ resolves: an empty wire_dtype
+    # inherits sketch_dtype, and a bfloat16 wire syncs sketch_dtype so
+    # the rht transform compute follows the wire. Parse-time use of
+    # --sketch_dtype warns (see parse_args).
     sketch_dtype: str = "float32"
+    # sketch-table WIRE dtype ("float32" | "bfloat16" | "int8"; "" =
+    # inherit the deprecated --sketch_dtype alias). What a table cell
+    # costs on the wire — per-client uploads AND every table-shaped
+    # collective:
+    # - bfloat16: uploads/psum/psum_scatter payloads travel rounded to
+    #   bf16 — half the ICI payload at ~2^-8 relative cell rounding;
+    #   server math stays fp32.
+    # - int8 (ops/wire.py): uploads quantize with per-column-block
+    #   symmetric abs-max scales and STOCHASTIC rounding (unbiased;
+    #   draws keyed off (seed, global_round, block) — deterministic and
+    #   replay/resume-safe), the mesh table reduce becomes an
+    #   all_to_all of int8 column shards + f32 scales with shard-local
+    #   dequantize-accumulate in f32 (int8 summation over W clients
+    #   would overflow), and the rounding residual is left to the
+    #   server error-feedback state. ~0.27x the f32 wire bytes (scales
+    #   included; ledger-gated <= 0.30x by dryrun_multichip). Requires
+    #   mode=sketch with a table server state (circ/hash impl; on a
+    #   mesh additionally the sharded server tail — the quantized
+    #   reduce is shard-shaped). Fail-fast on ineligible combinations.
+    wire_dtype: str = ""
+    # int8 wire quantization granularity: columns per abs-max scale
+    # block. Larger = less scale overhead (4/block bytes per cell);
+    # smaller = tighter scales. Shrunk automatically to the per-device
+    # column shard when the mesh shard is narrower; must then divide it.
+    wire_block: int = 256
     # rht row-at-a-time transforms (memory mode): -1 auto (on at dp >= 2^25),
     # 0 force batched, 1 force scanned. bf16 single-vector round-trips fit
     # batched even at GPT-2 scale and run ~2x faster
@@ -585,6 +614,65 @@ class FedConfig:
                 "--error_decay only applies to modes with virtual error " \
                 "(sketch, true_topk)"
         assert self.attn_impl in ("auto", "dense", "flash"), self.attn_impl
+        # ---- wire dtype resolution (--wire_dtype generalizes the
+        # deprecated --sketch_dtype alias; see the field comments)
+        assert self.sketch_dtype in ("float32", "bfloat16"), \
+            self.sketch_dtype
+        if self.wire_dtype == "":
+            object.__setattr__(self, "wire_dtype", self.sketch_dtype)
+        if self.wire_dtype not in WIRE_DTYPES:
+            raise ValueError(
+                f"--wire_dtype {self.wire_dtype!r} not in {WIRE_DTYPES}")
+        if self.wire_dtype == "bfloat16" and self.sketch_dtype != "bfloat16":
+            # keep the rht transform compute dtype following the wire,
+            # exactly as --sketch_dtype bfloat16 always did
+            object.__setattr__(self, "sketch_dtype", "bfloat16")
+        if self.wire_dtype == "float32" and self.sketch_dtype != "float32":
+            # an EXPLICIT f32 wire wins over the deprecated bf16 alias
+            # (the empty-wire inheritance above already ran, so a
+            # float32 here was requested, not defaulted): leaving
+            # sketch_dtype at bf16 would keep the runtime's bf16 wire
+            # armed while wire_dtype/telemetry/byte accounting all claim
+            # f32. An rht user wanting the bf16 TRANSFORM passes the
+            # alias alone — the wire then inherits bf16, as it always
+            # did.
+            object.__setattr__(self, "sketch_dtype", "float32")
+        if self.wire_dtype == "int8" and self.sketch_dtype != "float32":
+            # an explicit int8 wire WINS over the deprecated bf16 alias
+            # (leaving sketch_dtype at bf16 would arm the runtime's bf16
+            # rounding branch, which shadows the int8 wire on the
+            # per-client/single-device paths while the byte accounting
+            # reports int8 — the silently-wrong-wire failure this
+            # resolution exists to prevent; rht, the only other
+            # consumer of sketch_dtype, is rejected with int8 below)
+            object.__setattr__(self, "sketch_dtype", "float32")
+        if self.wire_block < 8:
+            raise ValueError(
+                f"--wire_block {self.wire_block} must be >= 8: each block "
+                "pays 4 bytes of f32 scale, so blocks below 8 columns "
+                "spend more on scales than a bf16 wire spends on cells")
+        if self.wire_dtype == "int8":
+            # fail fast on combinations the quantized wire cannot serve
+            # (the silently-ignored-flag contract); topology-dependent
+            # blockers (mesh without the sharded server tail, the
+            # dense-preimage auto path) fail at runtime init where the
+            # mesh is resolved
+            if self.mode != "sketch":
+                raise ValueError(
+                    f"--wire_dtype int8 requires --mode sketch (mode="
+                    f"{self.mode} has no table-shaped wire to quantize; "
+                    "dense-mode payloads keep their f32 wire)")
+            if self.sketch_impl == "rht":
+                raise ValueError(
+                    "--wire_dtype int8 is unsupported with sketch_impl="
+                    "rht: its dense transform has no cell-addressable "
+                    "table to block-quantize (use circ or hash)")
+            if self.sketch_server_state == "dense":
+                raise ValueError(
+                    "--wire_dtype int8 is unsupported with "
+                    "--sketch_server_state dense: that server path "
+                    "consumes the dense aggregated gradient, so no table "
+                    "crosses the wire to quantize")
         assert self.sketch_fused_encode in ("auto", "on", "off"), \
             self.sketch_fused_encode
         if self.sketch_fused_encode == "on" and self.mode != "sketch":
@@ -774,6 +862,27 @@ class FedConfig:
             "fedavg": self.grad_size,
         }[self.mode]
 
+    def upload_wire_bytes(self, block: Optional[int] = None) -> float:
+        """Exact simulated per-client upload bytes under the wire dtype
+        (the paper's first-class metric; reference byte table
+        fed_aggregator.py:291-299 counted 4 bytes/float).
+
+        float32 (and every non-sketch mode): 4 bytes per transmitted
+        float — byte-identical to the pre-wire accounting. bfloat16:
+        2 bytes per table cell. int8: 1 byte per cell PLUS 4 bytes of
+        f32 scale per ``block`` cells per row (``block`` defaults to
+        cfg.wire_block; the runtime passes its resolved effective block
+        so the accounting matches what actually crosses the wire).
+        """
+        if self.mode != "sketch" or self.wire_dtype == "float32":
+            return 4.0 * self.upload_floats
+        cells = self.num_rows * self.num_cols
+        if self.wire_dtype == "bfloat16":
+            return 2.0 * cells
+        b = int(block or self.wire_block)
+        n_scales = self.num_rows * (-(-self.num_cols // b))
+        return float(cells + 4 * n_scales)
+
     @property
     def needs_client_velocities(self) -> bool:
         # reference: fed_aggregator.py:127-129
@@ -934,7 +1043,20 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
     p.add_argument("--sketch_impl", choices=("circ", "hash", "rht"),
                    default="circ")
     p.add_argument("--sketch_dtype", choices=("float32", "bfloat16"),
-                   default="float32")
+                   default=None,
+                   help="DEPRECATED alias of --wire_dtype (parse-time "
+                        "warning; kept for old invocations — rht "
+                        "transform compute dtype still follows it)")
+    p.add_argument("--wire_dtype", choices=WIRE_DTYPES, default="",
+                   help="sketch-table wire dtype: float32 (default), "
+                        "bfloat16 (half the table payload, ~2^-8 cell "
+                        "rounding), or int8 (block-quantized with "
+                        "stochastic rounding + f32 scales, ~0.27x the "
+                        "f32 wire; residual absorbed by server EF — "
+                        "see ops/wire.py)")
+    p.add_argument("--wire_block", type=int, default=256,
+                   help="int8 wire: columns per abs-max scale block "
+                        "(scale overhead = 4/block bytes per cell)")
     p.add_argument("--sketch_scan_rows", type=int, default=-1,
                    choices=(-1, 0, 1))
     p.add_argument("--pallas", choices=("auto", "on", "off"), default="auto",
@@ -1171,4 +1293,15 @@ def parse_args(argv=None, default_lr: Optional[float] = None) -> FedConfig:
     kw = vars(ns)
     mesh_shape = tuple(int(x) for x in kw.pop("mesh_shape").split(",") if x)
     mesh_axes = tuple(x for x in kw.pop("mesh_axes").split(",") if x)
+    if kw.get("sketch_dtype") is not None:
+        # deprecated alias (ISSUE 14): --sketch_dtype keeps working but
+        # warns once at parse time; an explicit --wire_dtype wins
+        import sys
+        print("WARNING: --sketch_dtype is a deprecated alias of "
+              "--wire_dtype (it now also covers the int8 quantized "
+              "wire); update the invocation.", file=sys.stderr)
+        if not kw.get("wire_dtype"):
+            kw["wire_dtype"] = kw["sketch_dtype"]
+    else:
+        kw["sketch_dtype"] = "float32"
     return FedConfig(mesh_shape=mesh_shape, mesh_axes=mesh_axes, **kw)

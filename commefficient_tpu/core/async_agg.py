@@ -60,6 +60,21 @@ Async + DP remains sound (worker noise/clip are per-client ops before
 the sum; server noise draws at commit), it just follows a different —
 still deterministic — noise stream than the lockstep run.
 
+Wire composition
+----------------
+The quantized sketch wire (``--wire_dtype``; ops/wire.py) composes for
+free: the cohort step applies the wire BEFORE its payload leaves the
+executable (bf16 rounding or int8 quantize->all_to_all->dequantize on
+the collective, per-client round-trips single-device), so by the time a
+cohort sum reaches :meth:`AsyncAggregator` it is an ordinary f32 array
+— buffer merges stay pure f32 additions and the staleness discount
+multiplies dequantized values (a scalar times the dequantized table is
+the dequantization of nothing the wire ever carried — the discount is
+server-side, after the wire, exactly like the sync normalization). The
+int8 rounding draws key off the server version (``state.step``), which
+K=1/M=1 shares with the sync round — the bit-identity gate covers the
+int8 arm in ``__graft_entry__._wire_gate``.
+
 Soundness
 ---------
 Buffered merging is sound exactly when the server consumes the cohort

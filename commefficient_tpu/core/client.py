@@ -127,6 +127,25 @@ def quarantine_zero(tx: jax.Array, n_valid: jax.Array,
     return tx, n_valid, results, fin
 
 
+def int8_wire_uploads(cfg: FedConfig, tx: jax.Array, step: jax.Array,
+                      block: int, slot0=0) -> jax.Array:
+    """Simulated int8 wire on PER-CLIENT table uploads (--wire_dtype
+    int8, non-deferred encode — the path that keeps per-client tables
+    for the table clip): each client's (r, c) table quantizes with
+    per-column-block abs-max scales + stochastic rounding and
+    dequantizes in f32 before the server sum — the server only ever
+    sees what crossed the wire. Draws key off (seed, round, GLOBAL
+    slot, cell): ``slot0`` offsets the local slot index by the mesh
+    shard's base so shards never share a rounding stream. The residual
+    ``tx - tx'`` is ordinary compression noise to the server EF."""
+    from commefficient_tpu.ops.wire import wire_round_trip
+    W = tx.shape[0]
+    slots = jnp.arange(W, dtype=jnp.int32) + slot0
+    return jax.vmap(
+        lambda t, w: wire_round_trip(t, block, seed=cfg.seed,
+                                     round_idx=step, salt=w))(tx, slots)
+
+
 # coalesce adjacent gradient leaves into at-least-this-many-element
 # chunks before the streaming encode: biases/layernorm leaves are tiny,
 # and one encode_accum per 768-element leaf would pay the per-range

@@ -336,6 +336,57 @@ class FedRuntime:
         # _decode_step / _reduce_partials; bit-identity dryrun-gated).
         self._reduce_in_decode = (self._sharded_server
                                   and cfg.decode_overlap)
+        # ---- int8 quantized wire (--wire_dtype int8; ops/wire.py):
+        # clients quantize their table contribution with per-column-
+        # block abs-max scales + stochastic rounding (draws keyed off
+        # (seed, global_round, device/slot, cell) — deterministic,
+        # replay/resume-safe), the mesh table reduce becomes an
+        # all_to_all of int8 column shards + f32 scales with
+        # shard-local dequantize-accumulate in f32 (int8 summation over
+        # W clients would overflow; f32 local accumulation keeps the
+        # server momentum/EF numerics untouched), and the rounding
+        # residual lands in the aggregate where the server error
+        # feedback absorbs it. int8 is an EXPLICIT request, so every
+        # blocker is a hard error (no silent auto-fallback — a
+        # compression study must never silently measure the f32 wire);
+        # config.__post_init__ already rejected the topology-free
+        # blockers (non-sketch mode, rht, dense server state).
+        self._int8_wire = False
+        self._wire_block = 0
+        if cfg.mode == "sketch" and cfg.wire_dtype == "int8":
+            problems = []
+            if self._dense_preimage:
+                problems.append(
+                    "the dense-preimage server state consumes the dense "
+                    "aggregated gradient — no table crosses the wire")
+            if mesh is not None and not self._sharded_server:
+                problems.append(
+                    "a mesh without the sharded server tail: the "
+                    "quantized reduce is an all_to_all of int8 COLUMN "
+                    "SHARDS, which only the reduce-scattered tail "
+                    "consumes (sharded-server blockers:\n    "
+                    + "\n    ".join(ss_problems or ["(disabled by flag)"])
+                    + ")")
+            n_dev = mesh.shape[self._axis] if mesh is not None else 1
+            shard_c = cfg.num_cols // max(n_dev, 1)
+            blk = min(cfg.wire_block, shard_c)
+            if shard_c == 0 or shard_c % max(blk, 1):
+                problems.append(
+                    f"--wire_block {cfg.wire_block} does not tile the "
+                    f"per-device column shard ({shard_c} cols on "
+                    f"{n_dev} devices): pick a --wire_block dividing "
+                    "num_cols / n_devices")
+            if problems:
+                raise ValueError(
+                    "--wire_dtype int8 is unavailable for this "
+                    "configuration:\n  " + "\n  ".join(problems))
+            self._int8_wire = True
+            self._wire_block = blk
+        # exact per-client simulated upload bytes under the wire dtype
+        # (4 * upload_floats for the f32 wire — the pre-wire constant,
+        # so the f32 round's HLO stays byte-identical)
+        self._upload_bytes = cfg.upload_wire_bytes(self._wire_block
+                                                   or None)
         # compression-signal health diagnostics (telemetry/signals.py):
         # cheap on-device reductions appended to the round's metrics.
         # Gated on telemetry too: with --no_telemetry nothing ever reads
@@ -729,7 +780,7 @@ class FedRuntime:
 
     # ------------------------------------------------- robustness tail
 
-    def _transmit_tail(self, tx, out, adv, ref, client_rngs):
+    def _transmit_tail(self, tx, out, adv, ref, client_rngs, step=None):
         """Shared per-client transmitted-space tail of the sync round's
         and async cohort's client blocks: adversarial injection ->
         nonfinite quarantine -> wire rounding -> robust (or plain-sum)
@@ -767,6 +818,17 @@ class FedRuntime:
                     and cfg.mode == "sketch")
             if wire and not self._defer_encode and tx.ndim == 3:
                 tx = tx.astype(td).astype(jnp.float32)
+            elif (self._int8_wire and not self._defer_encode
+                  and tx.ndim == 3):
+                # per-client int8 uploads (the non-deferred path keeps
+                # per-client tables — table clip): each slot quantizes
+                # with its GLOBAL slot index as salt so draws stay
+                # independent across mesh shards, and the server sums
+                # the dequantized f32 reconstructions
+                tx = client_lib.int8_wire_uploads(
+                    cfg, tx, step, self._wire_block,
+                    slot0=(lax.axis_index(self._axis) * tx.shape[0]
+                           if self._axis is not None else 0))
             if cfg.defense != "none":
                 agg, cur_med, defense_stats = robust_aggregate(
                     cfg, tx, n_valid, ref_thresh=ref,
@@ -887,7 +949,22 @@ class FedRuntime:
                        out_specs=(P(ax), tab, tab), check_vma=False)
         return fn(agg, Vvel_prev, Verr_prev, server_lr, cs)
 
-    def _reduce_partials(self, partials: jax.Array) -> jax.Array:
+    def _int8_reduce_scatter(self, agg: jax.Array,
+                             step: jax.Array) -> jax.Array:
+        """The quantized table reduce (called INSIDE the round's
+        shard_map): per-device int8 quantization of the local partial
+        table, an all_to_all of int8 column shards + f32 scales, and a
+        shard-local f32 dequantize-accumulate — returning the same
+        (r, c/n) column-shard layout the psum_scatter produced, so the
+        sharded server tail consumes it unchanged (ops/wire.py
+        int8_reduce_scatter owns the arithmetic)."""
+        from commefficient_tpu.ops.wire import int8_reduce_scatter
+        return int8_reduce_scatter(
+            agg, axis=self._axis, n_shards=self.mesh.shape[self._axis],
+            block=self._wire_block, seed=self.cfg.seed, round_idx=step)
+
+    def _reduce_partials(self, partials: jax.Array,
+                         step=None) -> jax.Array:
         """--decode_overlap + sharded server: the cohort left each
         device's LOCAL partial table stacked on the clients axis
         ((n, r, c), device i owning slot i) — run the deferred
@@ -898,6 +975,20 @@ class FedRuntime:
         rounding (the collective IS the wire)."""
         ax = self._axis
         td = self._table_dtype
+
+        if self._int8_wire:
+            # the int8 wire travels WITH the deferred collective exactly
+            # like the bf16 rounding: quantization draws key off the
+            # SAME state.step the monolithic round would use (the server
+            # tail has not advanced it yet), so the split round stays
+            # bitwise identical to the monolithic one
+            def blk8(part, step):
+                return self._int8_reduce_scatter(part[0], step)
+
+            return shard_map(blk8, mesh=self.mesh,
+                             in_specs=(P(ax, None, None), P()),
+                             out_specs=P(None, ax),
+                             check_vma=False)(partials, step)
 
         def blk(part):
             p = part[0]
@@ -935,7 +1026,9 @@ class FedRuntime:
             # quantiles (telemetry/clients.py) — the scatter below is the
             # same data keyed by client id over the whole universe
             down_slot = 4.0 * counts.astype(jnp.float32)
-            up_slot = jnp.full((num_workers,), 4.0 * cfg.upload_floats,
+            # exact wire-dtype payload (cfg.upload_wire_bytes): the f32
+            # wire keeps the pre-wire 4*upload_floats constant
+            up_slot = jnp.full((num_workers,), self._upload_bytes,
                                jnp.float32)
             download_bytes = jnp.zeros(self.num_clients, jnp.float32).at[
                 client_ids].set(down_slot)
@@ -987,7 +1080,7 @@ class FedRuntime:
                       if self._defense_ring else None)
 
         def client_block(used_weights, batch, mask, vel_rows, err_rows,
-                         client_rngs, lr, adv, ref, cs):
+                         client_rngs, lr, adv, ref, step, cs):
             if self._rows_cols and self._axis is not None:
                 # home->compute layout: each device holds a (W, d_row_pad/n)
                 # column slice of all round rows; ONE all_to_all turns it
@@ -1060,7 +1153,7 @@ class FedRuntime:
             # order stay byte-identical to the pre-defense round
             t_agg, results, n_valid, stats, client_finite, \
                 defense_stats, cur_med = self._transmit_tail(
-                    tx, out, adv, ref, client_rngs)
+                    tx, out, adv, ref, client_rngs, step)
             if t_agg is not None:
                 agg = t_agg
             sig_dense = None
@@ -1079,6 +1172,16 @@ class FedRuntime:
                 agg = cs.encode(agg)
             if wire and self._axis is None and agg.ndim == 2:
                 agg = agg.astype(td).astype(jnp.float32)
+            elif (self._int8_wire and self._axis is None
+                  and agg.ndim == 2 and self._defer_encode):
+                # single-device deferred/fused encode: one table crosses
+                # the simulated wire (the per-device-partial analogue of
+                # the mesh quantize; per-client tables were already
+                # quantized in _transmit_tail on the non-deferred path)
+                from commefficient_tpu.ops.wire import wire_round_trip
+                agg = wire_round_trip(agg, self._wire_block,
+                                      seed=cfg.seed, round_idx=step,
+                                      salt=0)
             n_total = n_valid.sum()
             if self._axis is not None:
                 # the aggregation spans every mesh axis: clients sum across
@@ -1103,11 +1206,15 @@ class FedRuntime:
                     # (r, c) replicated result never exists, and the
                     # momentum/EF tail runs on the shards
                     # (core/server.sharded_sketch_server_update). The
-                    # --sketch_dtype bfloat16 wire covers this collective
-                    # exactly like the psum it replaces: the barrier pins
-                    # the payload dtype against XLA hoisting the f32
-                    # convert back through the reduce.
-                    if td != jnp.float32:
+                    # bfloat16 wire covers this collective exactly like
+                    # the psum it replaces (the barrier pins the payload
+                    # dtype against XLA hoisting the f32 convert back
+                    # through the reduce); the int8 wire replaces the
+                    # reduce itself with the quantized all_to_all +
+                    # shard-local dequantize-accumulate.
+                    if self._int8_wire:
+                        agg = self._int8_reduce_scatter(agg, step)
+                    elif td != jnp.float32:
                         agg = lax.optimization_barrier(lax.psum_scatter(
                             agg.astype(td), self._axis,
                             scatter_dimension=1, tiled=True))
@@ -1205,6 +1312,7 @@ class FedRuntime:
                 P(),
                 row if self._adversary else None,      # adv slot mask
                 P() if self._defense_ring else None,   # normclip reference
+                P() if self._int8_wire else None,      # wire round key
                 jax.tree.map(lambda _: P(), cs),
             )
             # dense modes leave the block as a reduce_scattered shard of
@@ -1247,10 +1355,12 @@ class FedRuntime:
                                      in_specs=in_specs, out_specs=out_specs,
                                      check_vma=False)
 
+        step_arg = state.step if self._int8_wire else None
         agg, n_total, vel_new, err_new, results, n_valid, sig_dense, \
             client_grad_stats, client_finite, defense_stats, cur_med = \
             client_block(used_weights, batch, mask, vel_rows, err_rows,
-                         client_rngs, lr, adv_slot, ref_thresh, cs)
+                         client_rngs, lr, adv_slot, ref_thresh, step_arg,
+                         cs)
         out = client_lib.ClientOut(None, vel_new, err_new, results, n_valid,
                                    client_grad_stats)
         total = jnp.maximum(n_total, 1.0)
@@ -1486,7 +1596,7 @@ class FedRuntime:
             counts = self._download_coord_counts(state.coord_last_update,
                                                  thresholds)
             down_slot = 4.0 * counts.astype(jnp.float32)
-            up_slot = jnp.full((num_workers,), 4.0 * cfg.upload_floats,
+            up_slot = jnp.full((num_workers,), self._upload_bytes,
                                jnp.float32)
             download_bytes = jnp.zeros(self.num_clients, jnp.float32).at[
                 client_ids].set(down_slot)
@@ -1501,7 +1611,7 @@ class FedRuntime:
                       if self._defense_ring else None)
 
         def client_block(used_weights, batch, mask, client_rngs, lr, adv,
-                         ref, cs):
+                         ref, step, cs):
             # validate_async_combo guarantees no vel/err rows and no
             # topk_down here — otherwise byte-for-byte the sync block
             used = used_weights[: cfg.grad_size]
@@ -1535,7 +1645,7 @@ class FedRuntime:
             # --async_agg)
             t_agg, results, n_valid, stats, client_finite, \
                 defense_stats, cur_med = self._transmit_tail(
-                    tx, out, adv, ref, client_rngs)
+                    tx, out, adv, ref, client_rngs, step)
             if t_agg is not None:
                 agg = t_agg
             if (self._defer_encode and not self._dense_preimage
@@ -1543,6 +1653,14 @@ class FedRuntime:
                 agg = cs.encode(agg)
             if wire and self._axis is None and agg.ndim == 2:
                 agg = agg.astype(td).astype(jnp.float32)
+            elif (self._int8_wire and self._axis is None
+                  and agg.ndim == 2 and self._defer_encode):
+                # same single-device simulated wire as the sync round —
+                # the async K=1/M=1 bit-identity rides on it
+                from commefficient_tpu.ops.wire import wire_round_trip
+                agg = wire_round_trip(agg, self._wire_block,
+                                      seed=cfg.seed, round_idx=step,
+                                      salt=0)
             n_total = n_valid.sum()
             if self._axis is not None:
                 all_axes = tuple(self.mesh.axis_names)
@@ -1562,8 +1680,11 @@ class FedRuntime:
                     agg = agg[None]
                 elif self._sharded_server:
                     # same reduce-scattered table collective as the
-                    # sync round's client block (bf16 barrier-pinned)
-                    if td != jnp.float32:
+                    # sync round's client block (bf16 barrier-pinned;
+                    # int8 = the quantized all_to_all reduce)
+                    if self._int8_wire:
+                        agg = self._int8_reduce_scatter(agg, step)
+                    elif td != jnp.float32:
                         agg = lax.optimization_barrier(lax.psum_scatter(
                             agg.astype(td), self._axis,
                             scatter_dimension=1, tiled=True))
@@ -1596,6 +1717,7 @@ class FedRuntime:
             in_specs = (P(), batch_specs, row, row, P(),
                         row if self._adversary else None,
                         P() if self._defense_ring else None,
+                        P() if self._int8_wire else None,
                         jax.tree.map(lambda _: P(), cs))
             dense_agg_spec = P(tuple(self.mesh.axis_names))
             if cfg.mode != "sketch":
@@ -1627,7 +1749,7 @@ class FedRuntime:
         agg, n_total, results, n_valid, grad_stats, client_finite, \
             defense_stats, cur_med = client_block(
                 state.ps_weights, batch, mask, client_rngs, lr, adv_slot,
-                ref_thresh, cs)
+                ref_thresh, state.step if self._int8_wire else None, cs)
 
         client_stats = None
         if self._client_stats:
@@ -1788,8 +1910,11 @@ class FedRuntime:
         if self._reduce_in_decode:
             # the cohort deferred the table reduce to THIS executable
             # (stacked per-device partials): run the reduce-scatter
-            # first, then normalize — the sync round's exact order
-            cohort_sum = self._reduce_partials(cohort_sum)
+            # first, then normalize — the sync round's exact order.
+            # state.step has not advanced yet, so the int8 wire's
+            # quantization draws match the monolithic round's bitwise.
+            cohort_sum = self._reduce_partials(
+                cohort_sum, state.step if self._int8_wire else None)
         agg = cohort_sum / jnp.maximum(n_total, 1.0)
         fields, _update, _Vvel, _Verr = self._server_tail_fields(
             state, agg, lr, server_rng, cs)

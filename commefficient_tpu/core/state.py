@@ -16,6 +16,13 @@ changed — and ``client_last_round``. A client's download cost is then
 4 bytes x |{i : coord_last_update[i] >= client_last_round[c]}|, which is
 *exact* (the reference's deque clamps staleness at 10/participation and
 underestimates), O(d) memory instead of O(d·history), and a pure reduction.
+
+Upload accounting is wire-dtype-exact since schema v9
+(``FedConfig.upload_wire_bytes``): the f32 wire keeps the reference's
+4 bytes/float, ``--wire_dtype bfloat16`` counts 2 bytes/cell, and
+``--wire_dtype int8`` counts 1 byte/cell PLUS the 4-byte f32 scale per
+column block — the simulated payload is exactly what the quantized wire
+(ops/wire.py) puts on it, scales included.
 """
 
 from __future__ import annotations

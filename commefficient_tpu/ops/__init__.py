@@ -11,8 +11,14 @@ from commefficient_tpu.ops.sketch import (
     sketch_l2estimate,
 )
 from commefficient_tpu.ops.rht import RHTSketch, make_rht_sketch
+from commefficient_tpu.ops.wire import (WIRE_DTYPES, dequantize_table,
+                                        quantize_table, wire_round_trip)
 
 __all__ = [
+    "WIRE_DTYPES",
+    "quantize_table",
+    "dequantize_table",
+    "wire_round_trip",
     "topk",
     "topk_with_idx",
     "median_axis0",

@@ -390,6 +390,20 @@ class CirculantSketch:
     def clip(self, table: jax.Array, clip: float) -> jax.Array:
         return clip_by_l2_norm(table, clip)
 
+    # --wire_dtype int8 entry points (ops/wire.py): the wire quantizes
+    # TABLE CELLS, so it is sketch-impl-agnostic — mirrored on
+    # CountSketch so wire consumers stay implementation-blind
+    def quantize_wire(self, table: jax.Array, block: int, *, seed: int,
+                      round_idx, salt=0):
+        from commefficient_tpu.ops.wire import quantize_table
+        return quantize_table(table, block, seed=seed,
+                              round_idx=round_idx, salt=salt)
+
+    def dequantize_wire(self, q: jax.Array, scale: jax.Array,
+                        block: int) -> jax.Array:
+        from commefficient_tpu.ops.wire import dequantize_table
+        return dequantize_table(q, scale, block)
+
 
 def make_circulant_sketch(d: int, c: int, r: int, num_blocks: int = 1,
                           seed: int = 42,

@@ -126,6 +126,20 @@ class CountSketch:
         exactly the 2-D branch of clip_by_l2_norm."""
         return clip_by_l2_norm(table, clip)
 
+    # --wire_dtype int8 entry points (ops/wire.py): the wire quantizes
+    # TABLE CELLS, so it is sketch-impl-agnostic — these exist so wire
+    # consumers stay implementation-blind like every other table op
+    def quantize_wire(self, table: jax.Array, block: int, *, seed: int,
+                      round_idx, salt=0):
+        from commefficient_tpu.ops.wire import quantize_table
+        return quantize_table(table, block, seed=seed,
+                              round_idx=round_idx, salt=salt)
+
+    def dequantize_wire(self, q: jax.Array, scale: jax.Array,
+                        block: int) -> jax.Array:
+        from commefficient_tpu.ops.wire import dequantize_table
+        return dequantize_table(q, scale, block)
+
 
 def make_sketch(d: int, c: int, r: int, num_blocks: int = 1,
                 seed: int = 42) -> CountSketch:

@@ -1,0 +1,199 @@
+"""Int8 quantized wire for sketch tables (``--wire_dtype int8``).
+
+The sketch table is the round's irreducible communication (PAPER.md
+§2.1/§2.3 — the sketch is linear, so the table reduce IS the
+aggregation), and after the PR-11 reduce-scatter the remaining lever is
+the bytes per CELL on the ICI wire. This module owns the cell
+arithmetic of that lever:
+
+- :func:`quantize_table` — symmetric per-column-block abs-max int8
+  quantization of an (r, c) table: each ``block`` consecutive columns
+  of a row share one f32 scale ``absmax / 127``, and cells round
+  STOCHASTICALLY (unbiased: ``E[q * scale] == x`` exactly) so the
+  rounding noise is zero-mean and the server's error-feedback state
+  absorbs it like any other compression noise instead of accumulating
+  a bias.
+- :func:`dequantize_table` / :func:`dequantize_accum` — the f32
+  reconstruction, and the shard-local accumulate the quantized
+  reduce-scatter uses (int8 summation over W clients would overflow at
+  W >= 2; dequantize-then-add keeps the server momentum/EF numerics
+  untouched).
+- :func:`wire_round_trip` — quantize+dequantize in one call: the
+  single-device simulation of the wire (what a client's upload looks
+  like after the server decodes it).
+
+Determinism contract: the stochastic-rounding draws come from a
+counter-based hash (the murmur finalizer ops/sketch.py already uses for
+bucket/sign streams — no PRNG key threading) keyed off ``(seed,
+global_round, salt, cell)``, where ``salt`` distinguishes independent
+quantizers in one round (the device index on a mesh, the client slot on
+the per-client path). Replaying a round — including across a
+kill/resume, where ``global_round`` comes back out of the checkpoint —
+reproduces every draw bitwise; that is what makes the crash-resume gate
+of ``__graft_entry__.dryrun_multichip`` hold for int8 runs.
+
+Everything here is pure jnp (vector ALU only — the same
+compute-over-residency trade the sketch hashing makes) and has an exact
+numpy reference in tests/test_wire.py.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from commefficient_tpu.config import WIRE_DTYPES  # noqa: F401  (re-export)
+from commefficient_tpu.ops.sketch import _mix32
+
+_U32 = jnp.uint32
+
+INT8_MAX = 127.0
+# salt-namespace offset of the REDUCE quantizer (int8_reduce_scatter):
+# per-client uploads salt by global slot index (0..W_total-1) and the
+# mesh reduce by device index — without the offset, device j's
+# partial-sum quantization would reuse client slot j's exact rounding
+# stream in the same round (at 1 client/device the partial IS that
+# client's dequantized table, and E[Q_u(Q_u(x))] != x — the shared
+# draws break the per-quantizer unbiasedness the EF-absorption story
+# rests on). 2^30 is far above any client universe.
+REDUCE_SALT = 1 << 30
+# bytes per table cell on the wire, plus (int8 only) 4 bytes per
+# ``block`` cells of scale overhead — see FedConfig.upload_wire_bytes
+WIRE_CELL_BYTES = {"float32": 4, "bfloat16": 2, "int8": 1}
+
+
+def wire_uniform(r: int, c: int, *, seed: int, round_idx,
+                 salt) -> jax.Array:
+    """Deterministic U[0, 1) draws for every cell of an (r, c) table.
+
+    Keyed off ``(seed, round_idx, salt, row, col)``: the static cell
+    grid mixes with the static seed first, and the TRACED (round, salt)
+    pair folds in afterwards — so XLA cannot constant-fold the draws
+    (they genuinely change per round) but the per-cell stream is
+    reproducible from the checkpointed round counter alone.
+    """
+    rows = jnp.arange(r, dtype=_U32)
+    cols = jnp.arange(c, dtype=_U32)
+    base = rows[:, None] * _U32(0x01000193) + cols[None, :]
+    h = _mix32(base ^ (_U32(seed) * _U32(0x9E3779B1) + _U32(0x7F4A7C15)))
+    rs = _mix32(jnp.asarray(round_idx).astype(_U32) * _U32(0x85EBCA77)
+                + jnp.asarray(salt).astype(_U32) * _U32(0xC2B2AE3D))
+    h = _mix32(h + rs)
+    # 24 high-entropy bits -> [0, 1): exactly representable in f32, and
+    # strictly < 1 so floor(x + u) can never round a whole number up
+    return (h >> _U32(8)).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+
+
+def quantize_table(table: jax.Array, block: int, *, seed: int,
+                   round_idx, salt, stochastic: bool = True
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-column-block abs-max int8 quantization.
+
+    ``table`` is (r, c) f32 with ``c % block == 0``. Returns
+    ``(q, scale)``: ``q`` (r, c) int8, ``scale`` (r, c // block) f32
+    where ``scale = absmax(block) / 127``. Reconstruction is
+    ``q * scale`` (:func:`dequantize_table`); with ``stochastic`` the
+    rounding is ``floor(x / scale + u)`` for the keyed uniform ``u``,
+    which is exactly unbiased per cell. An all-zero block keeps scale 0
+    and quantizes to exact zeros; a non-finite cell poisons its block's
+    scale (abs-max propagates NaN), so a diverging upload still trips
+    the round's non-finite detection after dequantize — the wire never
+    launders a NaN into a finite int8.
+    """
+    r, c = table.shape
+    assert c % block == 0, (table.shape, block)
+    g = table.astype(jnp.float32).reshape(r, c // block, block)
+    absmax = jnp.max(jnp.abs(g), axis=2)
+    scale = absmax / jnp.float32(INT8_MAX)
+    # guard the division only: zero blocks divide by 1 and stay exact
+    # zeros; NaN blocks keep their NaN scale (NaN > 0 is False, so the
+    # divisor is 1 and the NaN cells flow into q's clip below — the
+    # SCALE carries the poison to the dequantized output)
+    safe = jnp.where(scale > 0, scale, 1.0)
+    x = g / safe[:, :, None]
+    if stochastic:
+        u = wire_uniform(r, c, seed=seed, round_idx=round_idx, salt=salt)
+        q = jnp.floor(x + u.reshape(r, c // block, block))
+    else:
+        q = jnp.round(x)
+    # |x| <= 127 by construction; the clip only absorbs fp edge cases
+    # of the abs-max division (and pins NaN to a harmless in-range
+    # value — the NaN scale still poisons the reconstruction)
+    q = jnp.clip(q, -INT8_MAX, INT8_MAX)
+    return q.reshape(r, c).astype(jnp.int8), scale
+
+
+def dequantize_table(q: jax.Array, scale: jax.Array,
+                     block: int) -> jax.Array:
+    """f32 reconstruction of :func:`quantize_table`'s output."""
+    r, c = q.shape
+    g = q.astype(jnp.float32).reshape(r, c // block, block)
+    return (g * scale[:, :, None]).reshape(r, c)
+
+
+def dequantize_accum(q: jax.Array, scale: jax.Array,
+                     block: int) -> jax.Array:
+    """Dequantize-accumulate a STACK of quantized contributions.
+
+    ``q`` is (n, r, c) int8 — one contribution per source (the
+    all_to_all'd per-device column shards of the quantized reduce) —
+    and ``scale`` (n, r, c // block) their scales. Returns the f32 sum
+    over the source axis: the accumulation happens in f32 AFTER
+    dequantize (int8 summation over sources would overflow at the
+    second contribution), in a fixed source order so the reduce is
+    bitwise reproducible.
+    """
+    n, r, c = q.shape
+    g = q.astype(jnp.float32).reshape(n, r, c // block, block)
+    return (g * scale[..., None]).sum(axis=0).reshape(r, c)
+
+
+def wire_round_trip(table: jax.Array, block: int, *, seed: int,
+                    round_idx, salt) -> jax.Array:
+    """Quantize + dequantize: the single-device simulation of one
+    upload crossing the int8 wire. The difference ``table - result`` is
+    the rounding residual the server error feedback absorbs."""
+    q, scale = quantize_table(table, block, seed=seed,
+                              round_idx=round_idx, salt=salt)
+    return dequantize_table(q, scale, block)
+
+
+def int8_reduce_scatter(agg: jax.Array, *, axis: str, n_shards: int,
+                        block: int, seed: int, round_idx) -> jax.Array:
+    """The quantized table reduce: what replaces ``psum_scatter`` under
+    ``--wire_dtype int8`` (traced inside the round's ``shard_map``).
+
+    Each device quantizes its LOCAL partial (r, c) table (salt = its
+    axis index + REDUCE_SALT, so devices draw independent rounding
+    noise in a namespace disjoint from the per-client uploads'), the int8
+    column shards and their f32 scales travel by ``all_to_all`` (device
+    j receives every device's shard j), and the receiver
+    dequantize-accumulates in f32 — returning the (r, c / n) column
+    shard of the summed table in the same layout ``psum_scatter``
+    produced, so the sharded server tail consumes it unchanged. The
+    optimization barriers pin the collectives' payload dtypes exactly
+    like the bf16 wire's barrier: without them XLA may hoist the f32
+    convert back through the (purely data-movement) all_to_all and the
+    wire silently re-widens.
+    """
+    r, c = agg.shape
+    shard_c = c // n_shards
+    sb = shard_c // block
+    # REDUCE_SALT keeps this quantizer's draw stream disjoint from the
+    # per-client upload quantizers' slot-salted streams (see the
+    # constant's comment)
+    salt = lax.axis_index(axis) + REDUCE_SALT
+    q, scale = quantize_table(agg, block, seed=seed, round_idx=round_idx,
+                              salt=salt)
+    q = lax.optimization_barrier(q)
+    scale = lax.optimization_barrier(scale)
+    q = lax.all_to_all(q.reshape(r, n_shards, shard_c), axis,
+                       split_axis=1, concat_axis=1)
+    scale = lax.all_to_all(scale.reshape(r, n_shards, sb), axis,
+                           split_axis=1, concat_axis=1)
+    # (r, n, shard_c) -> contributions on axis 1; accumulate in f32
+    return dequantize_accum(jnp.moveaxis(q, 1, 0),
+                            jnp.moveaxis(scale, 1, 0), block)

@@ -118,6 +118,51 @@ def ledger_from_compiled(compiled) -> List[Dict[str, Any]]:
         return []
 
 
+def collective_wire_bytes(entry: Dict[str, Any],
+                          n_devices: int) -> float:
+    """Modeled per-device ICI bytes of one collective under ring
+    algorithms — what actually crosses the wire, as opposed to the
+    entry's RESULT bytes (a reduce-scatter's result is 1/n of its
+    input, so raw result bytes would under-count it n-fold against an
+    all_to_all of the same payload):
+
+    - all-reduce: 2 * bytes * (n-1)/n (reduce-scatter + all-gather);
+    - reduce-scatter: input = n * result, each device sends
+      (n-1)/n of it -> result_bytes * (n-1);
+    - all-gather / all-to-all: each device sends (n-1)/n of the
+      (result-sized) payload;
+    - collective-permute: the whole payload moves once.
+
+    n == 1 is zero: a single-device "collective" crosses no wire.
+    """
+    n = max(int(n_devices), 1)
+    if n == 1:
+        return 0.0
+    b = float(entry["bytes"])
+    kind = entry["kind"]
+    if kind == "all-reduce":
+        return 2.0 * b * (n - 1) / n
+    if kind == "reduce-scatter":
+        return b * (n - 1)
+    if kind in ("all-gather", "all-to-all"):
+        return b * (n - 1) / n
+    return b
+
+
+def table_reduce_wire_bytes(entries: List[Dict[str, Any]],
+                            n_devices: int) -> float:
+    """Per-device ICI bytes of the round's table-REDUCE collectives:
+    the reduce-scattered f32/bf16 table, or the int8 column-shard +
+    f32-scale all_to_alls that replace it under ``--wire_dtype int8``
+    (ops/wire.py). In the sketch round these two kinds ARE the table
+    reduce — the rows_cols all_to_alls exist only for dense-mode client
+    rows — so filtering by kind needs no size heuristics. This is the
+    quantity ISSUE-14's dryrun gate bounds (int8 <= 0.30x f32) and
+    ``teleview diff --wire_bytes_growth`` regresses."""
+    return sum(collective_wire_bytes(e, n_devices) for e in entries
+               if e["kind"] in ("reduce-scatter", "all-to-all"))
+
+
 def summarize_ledger(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Aggregate a ledger into the ``collectives`` telemetry event body:
     per-kind launch counts, total payload bytes, and the raw ops list."""

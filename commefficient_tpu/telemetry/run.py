@@ -60,6 +60,7 @@ def _sketch_geometry(cfg) -> Optional[Dict[str, Any]]:
         "ef": cfg.sketch_ef,
         "server_state": cfg.sketch_server_state,
         "dtype": cfg.sketch_dtype,
+        "wire_dtype": getattr(cfg, "wire_dtype", None) or cfg.sketch_dtype,
     }
 
 
@@ -73,6 +74,10 @@ class RunTelemetry:
                  resume_info: Optional[Dict[str, Any]] = None):
         self.logdir = logdir
         self.run_type = run_type
+        # kept for the schema-v9 wire fields: collectives/signals/bench
+        # events name the run's table wire dtype (None for cfg-less
+        # streams — the emitters take an explicit override)
+        self.cfg = cfg
         self.path = os.path.join(logdir, TELEMETRY_BASENAME)
         self._seq = 0
         # serialize writers: the round loop owns most events, but the
@@ -421,8 +426,19 @@ class RunTelemetry:
                    last_round=self.last_round,
                    last_epoch=self.last_epoch)
 
-    def bench_event(self, metric: str, result: Dict[str, Any]) -> None:
-        self.event("bench", metric=metric, result=result)
+    def _wire_dtype(self) -> Optional[str]:
+        """The run's sketch-table wire dtype for the schema-v9 wire
+        fields: the resolved --wire_dtype in sketch mode, null for
+        cfg-less streams or modes with no table wire."""
+        if self.cfg is None or getattr(self.cfg, "mode", None) != "sketch":
+            return None
+        return (getattr(self.cfg, "wire_dtype", None)
+                or getattr(self.cfg, "sketch_dtype", None))
+
+    def bench_event(self, metric: str, result: Dict[str, Any],
+                    wire_dtype: Optional[str] = None) -> None:
+        self.event("bench", metric=metric, result=result,
+                   wire_dtype=wire_dtype or self._wire_dtype())
 
     def signals_event(self, *, rnd: int, mode: str,
                       signals: Dict[str, Any],
@@ -437,7 +453,8 @@ class RunTelemetry:
         self.event("signals", round=rnd, mode=mode, **signals,
                    download_bytes=download_bytes, upload_bytes=upload_bytes,
                    client_download_bytes=client_download_bytes,
-                   client_upload_bytes=client_upload_bytes)
+                   client_upload_bytes=client_upload_bytes,
+                   wire_dtype=self._wire_dtype())
 
     def client_stats_event(self, *, rnd: int, n_participants: int,
                            quantiles: Dict[str, Any],
@@ -563,9 +580,34 @@ class RunTelemetry:
         """Collective inventory of one compiled executable — emitted by
         the JitWatcher next to each `compile` event, so a count
         regression (the 32x all_to_all unroll class) shows in every
-        run's stream."""
-        from commefficient_tpu.telemetry.collectives import summarize_ledger
-        self.event("collectives", name=name, **summarize_ledger(ledger))
+        run's stream. Schema v9 adds the wire fields: the run's table
+        wire dtype and the modeled per-device ICI bytes of the
+        table-reduce collectives (null when no device count is known —
+        never a fake zero)."""
+        from commefficient_tpu.telemetry.collectives import (
+            summarize_ledger, table_reduce_wire_bytes)
+        table_bytes = None
+        try:
+            # the wire model needs the COLLECTIVE's participant count:
+            # prefer the run's configured mesh size (a 2-device mesh on
+            # an 8-device host must model (n-1) = 1, not 7); fall back
+            # to the process device count for cfg-less / ad-hoc-mesh
+            # streams (the dryrun/scaling arms pin them equal)
+            n = 1
+            if self.cfg is not None and getattr(self.cfg, "mesh_shape",
+                                                ()):
+                for dim in self.cfg.mesh_shape:
+                    n *= int(dim)
+            else:
+                import jax
+                n = len(jax.devices())
+            if n > 1:
+                table_bytes = table_reduce_wire_bytes(ledger, n)
+        except Exception:
+            pass
+        self.event("collectives", name=name, **summarize_ledger(ledger),
+                   wire_dtype=self._wire_dtype(),
+                   table_reduce_bytes=table_bytes)
 
     def write_summary(self, *, aborted: bool, n_rounds: int,
                       total_download_mib: Optional[float] = None,

@@ -20,7 +20,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterable, List, Tuple
 
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 # streams written by older code stay readable: v1 lacks the span /
 # utilization event types (added in v2), v2 lacks client_stats / alert
 # (added in v3), v3 lacks async_round (added in v4), v4 lacks defense
@@ -31,11 +31,13 @@ SCHEMA_VERSION = 8
 # fields (n_devices / mesh_shape, added in v7 for the scaling-curve
 # harness — FIELDS_SINCE_V7, same vintage-gated requirement), v7 lacks
 # the fault/resume event types and the manifest stream_id (added in v8
-# for crash recovery lineage — FIELDS_SINCE_V8), but each is otherwise
-# a subset of its successor — so the validator accepts any supported
-# manifest version. A version it does not know is the error, not a
-# version merely older than current.
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, SCHEMA_VERSION)
+# for crash recovery lineage — FIELDS_SINCE_V8), v8 lacks the quantized-
+# wire fields on collectives/signals/bench (wire_dtype and the modeled
+# table-reduce ICI bytes, added in v9 for --wire_dtype int8 —
+# FIELDS_SINCE_V9), but each is otherwise a subset of its successor —
+# so the validator accepts any supported manifest version. A version it
+# does not know is the error, not a version merely older than current.
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, SCHEMA_VERSION)
 TELEMETRY_BASENAME = "telemetry.jsonl"
 
 
@@ -189,9 +191,12 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "last_epoch": _opt_dict,      # last completed epoch record, if any
     },
     # benchmark stage result (bench.py / bench_gpt2.py share the stream)
+    # schema v9 adds wire_dtype so BENCH trajectory arms under different
+    # --wire_dtype settings stay distinguishable from the stream alone
     "bench": {
         "metric": _str,
         "result": _dict,
+        "wire_dtype": _opt_str,
     },
     # compression-signal health for one round (telemetry/signals.py):
     # on-device norms of the aggregated gradient / EF accumulators /
@@ -215,6 +220,7 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "upload_bytes": _opt_num,
         "client_download_bytes": _opt_list,  # per participating client,
         "client_upload_bytes": _opt_list,    # ordered by client_ids
+        "wire_dtype": _opt_str,              # v9: the table wire dtype
     },
     # collective inventory of one compiled executable (telemetry/
     # collectives.py): per-kind LAUNCH counts, total payload bytes and
@@ -227,7 +233,15 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "counts": _dict,                # kind -> launch count
         "total_bytes": _num,
         "ops": _list,                   # [{kind, n_elements, dtype, bytes,
-    },                                  #   combined_in}, ...]
+                                        #   combined_in}, ...]
+        # schema v9 (--wire_dtype int8): the configured table wire dtype
+        # and the MODELED per-device ICI bytes of the table-reduce
+        # collectives (reduce-scatter / all-to-all; collectives.py
+        # table_reduce_wire_bytes) — the quantized-wire regression
+        # channel `teleview diff --wire_bytes_growth` gates
+        "wire_dtype": _opt_str,
+        "table_reduce_bytes": _opt_num,
+    },
     # batched wall-time spans (telemetry/tracing.py): the tracer's
     # completed-span buffer, drained at the round-record cadence OUTSIDE
     # the timed region. Each span: {name, ts (seconds since t0 on the
@@ -446,6 +460,14 @@ FIELDS_SINCE_V8: Dict[str, Tuple[str, ...]] = {
     "manifest": ("stream_id",),
 }
 
+# fields ADDED in schema v9 (the quantized sketch wire, --wire_dtype
+# int8) — same vintage-gated requirement
+FIELDS_SINCE_V9: Dict[str, Tuple[str, ...]] = {
+    "collectives": ("wire_dtype", "table_reduce_bytes"),
+    "signals": ("wire_dtype",),
+    "bench": ("wire_dtype",),
+}
+
 
 def validate_event(obj: Any,
                    version: int = SCHEMA_VERSION) -> List[str]:
@@ -472,6 +494,7 @@ def validate_event(obj: Any,
     v6_only = FIELDS_SINCE_V6.get(kind, ())
     v7_only = FIELDS_SINCE_V7.get(kind, ())
     v8_only = FIELDS_SINCE_V8.get(kind, ())
+    v9_only = FIELDS_SINCE_V9.get(kind, ())
     for field, pred in spec.items():
         if field not in obj:
             if version < 6 and field in v6_only:
@@ -479,6 +502,8 @@ def validate_event(obj: Any,
             if version < 7 and field in v7_only:
                 continue
             if version < 8 and field in v8_only:
+                continue
+            if version < 9 and field in v9_only:
                 continue
             problems.append(f"{kind}: missing field {field!r}")
         elif not pred(obj[field]):

@@ -42,6 +42,10 @@ _SAMPLE_OVERRIDES = {
     "ops": [{"kind": "all-reduce", "n_elements": 192, "dtype": "f32",
              "bytes": 768, "combined_in": 0}],
     "counts": {"all-reduce": 1},
+    # schema-v9 quantized-wire fields (collectives/signals/bench): one
+    # realistic int8 arm — the table-reduce wire at ~0.27x of f32
+    "wire_dtype": "int8",
+    "table_reduce_bytes": 1428.0,
     "client_download_bytes": [4.0],
     "client_upload_bytes": [4.0],
     "spans": [{"name": "data_fetch", "ts": 0.0, "dur_s": 0.01,

@@ -43,6 +43,13 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_memory.py -q \
 env JAX_PLATFORMS=cpu python -m pytest tests/test_fused_encode.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
+# int8 quantized wire: a regression here (quantizer drifting from its
+# numpy reference, lost rounding determinism/resume replay, broken
+# byte accounting, a v9 schema/teleview gate drift) fails in seconds,
+# before the full suite
+env JAX_PLATFORMS=cpu python -m pytest tests/test_wire.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
 # sharded sketch server: a regression here (lost sharded==replicated
 # round parity, a drifting range decode or top-k merge, a table-sized
 # all-reduce sneaking back, broken eligibility fail-fasts, the teleview

@@ -213,7 +213,10 @@ def summarize(events: List[Dict[str, Any]], label: str = "") -> None:
     sk = man.get("sketch")
     print(f"   mode={cfgd.get('mode', '?')} grad_size={man.get('grad_size')}"
           + (f" sketch={sk['impl']} {sk['num_rows']}x{sk['num_cols']} "
-             f"k={sk['k']} ef={sk['ef']}" if sk else ""))
+             f"k={sk['k']} ef={sk['ef']}"
+             + (f" wire={sk['wire_dtype']}"
+                if sk.get("wire_dtype") not in (None, "float32") else "")
+             if sk else ""))
 
     comps = by_kind(events, "compile")
     if comps:
@@ -706,6 +709,20 @@ def diff(a: List[Dict[str, Any]], b: List[Dict[str, Any]],
             problems.append(
                 f"collectives[{name}]: payload bytes {ba} -> {bb} "
                 f"(> {args.bytes_ratio:.2f}x)")
+        # schema-v9 quantized-wire gate: the modeled table-reduce ICI
+        # bytes regressing past threshold means the wire silently
+        # re-widened (an int8 arm compiling the f32 reduce, a barrier
+        # lost to a jax upgrade) — the exact regression class
+        # --wire_dtype int8 exists to prevent
+        wa = _fin(ca[name].get("table_reduce_bytes"))
+        wb = _fin(cb[name].get("table_reduce_bytes"))
+        if wa is not None and wb is not None and wa > 0 \
+                and wb > wa * args.wire_bytes_growth:
+            problems.append(
+                f"collectives[{name}]: table-reduce wire bytes "
+                f"{wa:.0f} -> {wb:.0f} "
+                f"(> {args.wire_bytes_growth:.2f}x — the quantized "
+                "wire re-widened)")
 
     ma, mb = latest_memory_ledgers(a), latest_memory_ledgers(b)
     for name in sorted(set(ma) & set(mb)):
@@ -886,6 +903,11 @@ def main(argv=None) -> int:
     d.add_argument("--count_slack", type=int, default=0,
                    help="collective launch-count growth tolerated (default "
                         "0: any increase fails)")
+    d.add_argument("--wire_bytes_growth", type=float, default=1.05,
+                   help="max growth of the modeled table-reduce ICI "
+                        "bytes (collectives.table_reduce_bytes, schema "
+                        "v9) before the diff fails — catches a "
+                        "quantized wire silently re-widening to f32")
     d.add_argument("--bytes_ratio", type=float, default=1.05,
                    help="max collective payload-byte growth factor")
     d.add_argument("--signal_ratio", type=float, default=2.0,

@@ -259,7 +259,8 @@ def test_schema_rejects_malformed_new_events():
     assert validate_event({"event": "collectives", "t": 0.0, "seq": 0})
     ok = {"event": "collectives", "t": 0.0, "seq": 0, "name": "round_step",
           "n_collectives": 2, "counts": {"all-reduce": 2},
-          "total_bytes": 128, "ops": []}
+          "total_bytes": 128, "ops": [],
+          "wire_dtype": None, "table_reduce_bytes": None}
     assert validate_event(ok) == []
     bad = dict(ok, counts=["all-reduce"])
     assert validate_event(bad)
@@ -422,7 +423,10 @@ def _write_stream(path, error_norm=1.0, a2a_count=2, loss=2.0):
     tel = RunTelemetry(str(path), "test", cfg=None)
     tel.event("collectives", name="round_step", n_collectives=3 + a2a_count,
               counts={"all-reduce": 3, "all-to-all": a2a_count},
-              total_bytes=4096, ops=[])
+              total_bytes=4096, ops=[],
+              # schema v9 wire fields (hand-rolled event; the real
+              # emitter is RunTelemetry.collectives_event)
+              wire_dtype=None, table_reduce_bytes=None)
     sig = {k: 1.0 for k in SIGNAL_KEYS}
     sig["error_norm"] = error_norm
     tel.signals_event(rnd=1, mode="sketch", signals=sig,
