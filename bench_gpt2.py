@@ -234,7 +234,7 @@ def run(remat: bool = True, telemetry=None, profiler=None, *,
                 return (((b1 or 0) + (b2 or 0)) or None, l1, l2)
             compiled = runtime._round.lower(
                 runtime.init_state(), ids, batch, mask, lr,
-                runtime.cs).compile()
+                runtime.cs, runtime._gid).compile()
             return _cost(compiled) + (None,)
 
         try:

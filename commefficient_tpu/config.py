@@ -359,6 +359,21 @@ class FedConfig:
     # dense shadow error accumulator (2 x O(d) state, single-device
     # deferred-encode only)
     signals_exact: bool = False
+    # layer-wise compression attribution (telemetry/layer_signals.py):
+    # partition the model pytree into named parameter groups (coarse =
+    # path-pattern groups — embed/attn/mlp/norm-bias per block for the
+    # GPT-2 layout, stage-level for conv nets; leaf = one group per
+    # pytree leaf) and reduce the round's dense quantities per group
+    # inside the jitted round — per-group gradient/update/EF mass,
+    # top-k support counts, heavy-hitter recovery under
+    # --signals_exact. Emitted as schema-v10 `layer_signals` events at
+    # the signals cadence; "off" compiles the group machinery out
+    # entirely (round HLO byte-identical, tested). Gated exactly like
+    # signals: --no_signals / --no_telemetry / async / decode_overlap
+    # drop it too. Cost: one (d_pad,) int32 group-id map resident on
+    # device (sharded on a mesh — the same O(d) class as the byte
+    # accounting's coord_last_update) plus a few segment reductions.
+    signal_groups: str = "coarse"
     # fail (instead of warn) on configurations round 5 MEASURED divergent
     # — see core/server.py check_regime_health: local_topk with local
     # error feedback at dense-stable lr, subtract-EF at high collision
@@ -695,6 +710,10 @@ class FedConfig:
                 "async buffered aggregation already splits the round into "
                 "cohort and commit executables (and adds buffering "
                 "semantics on top). Drop one of the flags.")
+        if self.signal_groups not in ("coarse", "leaf", "off"):
+            raise ValueError(
+                f"--signal_groups {self.signal_groups!r} not in "
+                "('coarse', 'leaf', 'off')")
         assert self.telemetry_every >= -1, self.telemetry_every
         assert self.alert_action in ALERT_ACTIONS, self.alert_action
         assert self.alert_window >= 4, self.alert_window
@@ -1126,6 +1145,16 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                         "the exact dense error top-k); adds an O(d) "
                         "top-k per round, and a dense shadow error "
                         "accumulator for table-state sketch")
+    p.add_argument("--signal_groups", choices=("coarse", "leaf", "off"),
+                   default="coarse",
+                   help="layer-wise compression attribution "
+                        "(telemetry/layer_signals.py): parameter-group "
+                        "granularity of the per-group recovery signals "
+                        "emitted as layer_signals events — coarse = "
+                        "path-pattern groups (per-block attn/mlp/"
+                        "norm-bias, embed, head; stage-level for conv "
+                        "nets), leaf = one group per pytree leaf, off = "
+                        "compiled out of the round entirely")
     p.add_argument("--strict_regimes", action="store_true",
                    help="fail at startup (instead of warning) on "
                         "configurations measured divergent in round 5 "

@@ -396,6 +396,7 @@ class AsyncAggregator:
             "download_bytes": payload["download_bytes"],
             "upload_bytes": payload["upload_bytes"],
             "signals": None,
+            "layer_signals": None,
             "client_stats": payload["client_stats"],
             # robustness channel (core/runtime._cohort_step): the
             # defense-event scalars and the quarantine ledger's

@@ -310,6 +310,7 @@ class DecodeOverlapRound:
             "download_bytes": payload["download_bytes"],
             "upload_bytes": payload["upload_bytes"],
             "signals": None,
+            "layer_signals": None,
             "client_stats": payload["client_stats"],
             "defense": payload["defense"],
             "client_finite": payload["client_finite"],

@@ -535,6 +535,46 @@ class FedRuntime:
                   "the cost of the dense (d,) materialization.",
                   file=sys.stderr)
             self._signals_dense_cap = False
+        # ---- layer-wise compression attribution (telemetry/
+        # layer_signals.py): named parameter groups over the ravel-order
+        # coordinate line, reduced per group inside the jitted round
+        # (ops/segments.py scatter-adds keyed by a precomputed int32
+        # group-id map). Gated exactly like the scalar signals — off,
+        # the group machinery is compiled out entirely (HLO identity-
+        # tested); on, the gid map rides as a CALL-TIME jit argument
+        # (like cs: a (d_pad,) int32 constant baked into the HLO would
+        # ship ~d*4 bytes to the compiler at GPT-2 scale), sharded like
+        # the dense federated vectors so each device reduces its own
+        # coordinate shard and ONE small (G,) psum recombines — never a
+        # per-group collective unroll (dryrun-ledger-gated).
+        self._layer_signals = (self._signals
+                               and cfg.signal_groups != "off")
+        # the per-group DENSE gradient mass needs a dense aggregated
+        # gradient in the round: dense modes have it as the transmitted
+        # quantity itself; sketch only via the dense-preimage state or
+        # the single-device deferred-encode capture. Fused-encode and
+        # mesh sketch rounds emit it null — never fake zero — because
+        # restoring the dense gradient would cost exactly the (d,)
+        # buffer / collective those paths exist to remove (the PR-4
+        # client-stats NaN contract, applied to groups).
+        self._layer_grad_mass = (self._layer_signals
+                                 and (cfg.mode != "sketch"
+                                      or self._dense_preimage
+                                      or self._signals_dense_cap))
+        self.group_spec = None
+        self._gid = None
+        if self._layer_signals:
+            from commefficient_tpu.telemetry.layer_signals import \
+                make_group_spec
+            self.group_spec = make_group_spec(params, cfg.signal_groups)
+            assert self.group_spec.d == cfg.grad_size, (
+                self.group_spec.d, cfg.grad_size)
+            gid_np = self.group_spec.gid(self.d_pad)
+            if mesh is not None:
+                self._gid = jax.device_put(jnp.asarray(gid_np),
+                                           self.shardings.dense_vec)
+            else:
+                self._gid = jnp.asarray(gid_np)
         if cfg.mode == "fedavg":
             self._client_fn = client_lib.make_fedavg_client(
                 cfg, loss_fn_train, unravel, self.batch_size,
@@ -561,7 +601,14 @@ class FedRuntime:
                 self._round_step,
                 donate_argnums=(0,),
                 in_shardings=(state_sh, sh.round_axis, batch_sh,
-                              sh.round_axis, None, cs_sh),
+                              sh.round_axis, None, cs_sh,
+                              # gid: inferred from the argument's
+                              # committed layout (device_put dense_vec
+                              # in __init__) — a pinned entry here would
+                              # reject the legacy 6-argument lowerings
+                              # that omit it (see _round_step's
+                              # constant fallback)
+                              None),
                 out_shardings=(state_sh, None),
             )
             self._state_sharding = state_sh
@@ -1007,8 +1054,17 @@ class FedRuntime:
     # ------------------------------------------------------------- round step
 
     def _round_step(self, state: FedState, client_ids: jax.Array,
-                    batch: Any, mask: jax.Array, lr: jax.Array, cs=None):
+                    batch: Any, mask: jax.Array, lr: jax.Array, cs=None,
+                    gid=None):
         cfg = self.cfg
+        if gid is None and self._layer_signals:
+            # legacy 6-argument lowerings (tests/benches that lower the
+            # round directly) omit the group-id map: fall back to the
+            # runtime's copy as a trace-time constant. The REAL round
+            # (self.round) always passes it as an argument — a constant
+            # would serialize d_pad*4 bytes into the HLO shipped to the
+            # compiler at GPT-2 scale, the same reason cs is an argument
+            gid = self._gid
         num_workers = client_ids.shape[0]
         keys = jax.random.split(state.rng, num_workers + 2)
         rng, server_rng, client_rngs = keys[0], keys[1], keys[2:]
@@ -1389,6 +1445,43 @@ class FedRuntime:
                 dense_agg=sig_dense,
                 sig_vel=state.sig_Vvelocity, sig_err=state.sig_Verror)
 
+        # ---- layer-wise attribution (telemetry/layer_signals.py):
+        # per-group reductions of the same pre-padding quantities the
+        # scalar signals just measured — the conservation laws (group
+        # masses sum to the whole-vector norms squared, support counts
+        # sum to nnz) are dryrun-gated against exactly that pairing
+        layer_signals = None
+        if self._layer_signals:
+            from commefficient_tpu.telemetry.layer_signals import \
+                layer_group_signals
+            # dense gradient / dense EF sources, where the round holds
+            # them (see __init__._layer_grad_mass; None -> null fields)
+            dense = cfg.mode != "sketch" or self._dense_preimage
+            grad_dense = (agg if dense
+                          else sig_dense if self._layer_grad_mass
+                          else None)
+            err_dense = (Verr if dense
+                         else sig_err_new if sig_err_new is not None
+                         else None)
+            err_pre = None
+            if cfg.signals_exact:
+                # the SAME dense pre-feedback error round_signals'
+                # topk_overlap selects against (signals.py documents
+                # the two availability paths) — recomputed here from
+                # the pre-update state so the modules stay decoupled
+                rho = cfg.virtual_momentum
+                if state.sig_Verror is not None and sig_dense is not None:
+                    err_pre = (state.sig_Verror + sig_dense
+                               + rho * state.sig_Vvelocity)
+                elif cfg.mode == "true_topk" or (cfg.mode == "sketch"
+                                                 and dense):
+                    err_pre = (state.Verror + agg
+                               + rho * state.Vvelocity)[: cfg.grad_size]
+            layer_signals = layer_group_signals(
+                cfg, gid=gid, n_groups=self.group_spec.n_groups,
+                update=update, grad_dense=grad_dense,
+                err_dense=err_dense, err_pre=err_pre)
+
         # ---- per-client population stats (telemetry/clients.py): quantile
         # summaries along the client axis, riding the same async metrics
         # fetch as the loss — per-client vectors never leave the device
@@ -1514,6 +1607,8 @@ class FedRuntime:
             "download_bytes": download_bytes,
             "upload_bytes": upload_bytes,
             "signals": signals,              # dict of scalars, or None
+            # dict of (G,) per-group vectors, or None (layer_signals.py)
+            "layer_signals": layer_signals,
             "client_stats": client_stats,    # quantile summaries, or None
             "defense": defense,              # dict of scalars, or None
             # (W,) bool, quarantine mode only: the host-side ledger's
@@ -2003,7 +2098,8 @@ class FedRuntime:
         # with the `compile` event the JitWatcher emits for the same round
         with tracing.span("round_dispatch"):
             return self._round(state, jnp.asarray(client_ids, jnp.int32),
-                               batch, jnp.asarray(mask), lr, self.cs)
+                               batch, jnp.asarray(mask), lr, self.cs,
+                               self._gid)
 
     def val(self, state: FedState, batch, mask):
         """Masked evaluation on the current PS weights; returns

@@ -37,6 +37,7 @@ from commefficient_tpu.data.fed_sampler import mask_blocked
 from commefficient_tpu.faults import maybe_fault
 from commefficient_tpu.losses import make_cv_loss
 from commefficient_tpu.telemetry import (ProfilerWindow, UtilizationTracker,
+                                         layer_signals_to_host,
                                          signals_to_host, tracing)
 from commefficient_tpu.telemetry import maybe_create as make_telemetry
 from commefficient_tpu.telemetry.clients import (ParticipationLedger,
@@ -865,6 +866,18 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                                 upload_bytes=up_total,
                                 client_download_bytes=down_clients,
                                 client_upload_bytes=up_clients)
+                        if metrics.get("layer_signals"):
+                            # layer-wise attribution (layer_signals.py):
+                            # per-group vectors, same cadence — the
+                            # group_starvation monitor rule feeds off
+                            # this event via the stream forwarding
+                            telemetry.layer_signals_event(
+                                rnd=global_round, mode=cfg.mode,
+                                signal_groups=cfg.signal_groups,
+                                groups=runtime.group_spec.names,
+                                sizes=runtime.group_spec.sizes,
+                                values=layer_signals_to_host(
+                                    metrics["layer_signals"]))
                         if metrics.get("client_stats") is not None \
                                 and ledger is not None:
                             # per-client population quantiles (device-

@@ -39,6 +39,12 @@ from commefficient_tpu.telemetry.schema import (SCHEMA_VERSION,
                                                 validate_event,
                                                 validate_file,
                                                 validate_lines)
+from commefficient_tpu.telemetry.layer_signals import (LAYER_SIGNAL_KEYS,
+                                                       GroupSpec,
+                                                       layer_group_signals,
+                                                       layer_signals_to_host,
+                                                       make_group_spec,
+                                                       starved_groups)
 from commefficient_tpu.telemetry.signals import (SIGNAL_KEYS, round_signals,
                                                  signals_to_host)
 from commefficient_tpu.telemetry.tracing import (NullTracer, SpanTracer,
@@ -75,6 +81,12 @@ __all__ = [
     "SIGNAL_KEYS",
     "round_signals",
     "signals_to_host",
+    "LAYER_SIGNAL_KEYS",
+    "GroupSpec",
+    "layer_group_signals",
+    "layer_signals_to_host",
+    "make_group_spec",
+    "starved_groups",
     "ledger_from_hlo",
     "ledger_from_compiled",
     "round_ledger",

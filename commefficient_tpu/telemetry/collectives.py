@@ -189,5 +189,6 @@ def round_ledger(runtime, state, client_ids, batch, mask, lr=0.1):
     import jax.numpy as jnp
     lowered = runtime._round.lower(
         state, client_ids, batch, mask,
-        jnp.asarray(lr, jnp.float32), runtime.cs)
+        jnp.asarray(lr, jnp.float32), runtime.cs,
+        getattr(runtime, "_gid", None))
     return ledger_from_compiled(lowered.compile())

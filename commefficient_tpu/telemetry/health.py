@@ -51,7 +51,7 @@ from typing import Any, Dict, List, Optional
 SEVERITIES = ("info", "warn", "critical")
 # event kinds RunTelemetry forwards to an attached monitor
 MONITORED_KINDS = ("round", "signals", "utilization", "client_stats",
-                   "async_round", "defense", "memory")
+                   "async_round", "defense", "memory", "layer_signals")
 
 # The rule table: each rule watches ONE field of ONE event kind.
 # kind="z" fires on a robust z-score breach of the rolling history
@@ -126,6 +126,20 @@ RULES = (
     dict(name="hbm_pressure", event="memory", field="peak_bytes",
          kind="z", direction="high", severity="warn",
          mad_floor_abs=16 * 2**20),
+    # layer-wise compression attribution (schema v10, telemetry/
+    # layer_signals.py): the STARVATION signature — a parameter group
+    # holding a material share of the round's dense gradient energy
+    # while winning (almost) none of the k top-k coordinates, for a
+    # window of consecutive observations. This is the FetchSGD-lineage
+    # per-layer failure mode at high compression: small-mass layers
+    # lose the global top-k race and their signal rots in error
+    # feedback. kind="starvation" is evaluated per GROUP (not a scalar
+    # z-score) with the thresholds/window shared with teleview layers
+    # (layer_signals.STARVATION_*); silent when grad_mass is null
+    # (fused-encode / mesh sketch rounds) — starvation is measured
+    # against gradient mass, never guessed from the update side.
+    dict(name="group_starvation", event="layer_signals", field="topk_count",
+         kind="starvation", severity="warn"),
 )
 
 
@@ -206,6 +220,10 @@ class AnomalyMonitor:
         self.rules = tuple(rules)
         self._hist: Dict[str, deque] = {}
         self._quiet: Dict[str, int] = {}      # rule name -> obs remaining
+        # group_starvation streaks: group name -> consecutive
+        # observations the starvation predicate held (layer_signals.py
+        # starved_groups); a clean observation breaks the streak
+        self._starve: Dict[str, int] = {}
         self.alerts: List[Dict[str, Any]] = []
         self.nonfinite_counts: Dict[str, int] = {}
         self.n_observed = 0
@@ -250,7 +268,42 @@ class AnomalyMonitor:
             if quiet > 0:
                 self._quiet[name] = quiet - 1
             alert = None
-            if rule["kind"] == "nonfinite":
+            if rule["kind"] == "starvation":
+                # per-GROUP predicate over the layer_signals event (no
+                # scalar history): a group above the mass-share floor
+                # winning under the k-share floor for
+                # STARVATION_WINDOW consecutive observations starves.
+                # Dependency-free on purpose (layer_signals's helpers
+                # import nothing at module level) — `teleview alerts`
+                # replays identically on a machine without jax.
+                from commefficient_tpu.telemetry.layer_signals import (
+                    STARVATION_WINDOW, starved_groups)
+                starved = starved_groups(fields.get("groups") or [],
+                                         fields.get("grad_mass"),
+                                         fields.get("topk_count"))
+                now = {g for g, _, _ in starved}
+                for g in [g for g in self._starve if g not in now]:
+                    del self._starve[g]          # streak broken
+                ripe = []
+                for g, mass_share, win_share in starved:
+                    streak = self._starve.get(g, 0) + 1
+                    self._starve[g] = streak
+                    if streak >= STARVATION_WINDOW:
+                        ripe.append((g, mass_share, win_share))
+                if ripe and quiet <= 0:
+                    # one alert per firing, naming the hungriest group
+                    # (largest starved mass share); the full list rides
+                    # as an extra field for postmortems
+                    g, mass_share, win_share = max(ripe,
+                                                   key=lambda t: t[1])
+                    alert = dict(
+                        round=rnd, rule=name, severity=rule["severity"],
+                        metric=f"layer_signals.starvation[{g}]",
+                        value=round(win_share, 6), zscore=None,
+                        median=round(mass_share, 6), mad=None,
+                        window=STARVATION_WINDOW, action=self.action,
+                        starved=[list(r) for r in ripe])
+            elif rule["kind"] == "nonfinite":
                 # only a metric that WAS numeric turning null is a
                 # precursor; an always-null field is merely N/A
                 if not numeric and value is None and len(hist) > 0:
@@ -306,6 +359,9 @@ class AnomalyMonitor:
             "quiet": dict(self._quiet),
             "nonfinite_counts": dict(self.nonfinite_counts),
             "n_observed": self.n_observed,
+            # group_starvation streaks: a starvation window straddling
+            # a restart must keep counting, not restart cold
+            "starve": dict(self._starve),
         }
 
     def load_state_dict(self, d: Dict[str, Any]) -> None:
@@ -317,6 +373,8 @@ class AnomalyMonitor:
         self.nonfinite_counts = {m: int(n) for m, n in
                                  (d.get("nonfinite_counts") or {}).items()}
         self.n_observed = int(d.get("n_observed", 0))
+        self._starve = {g: int(n)
+                        for g, n in (d.get("starve") or {}).items()}
 
     # --------------------------------------------------------------- actions
 

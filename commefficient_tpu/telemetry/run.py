@@ -456,6 +456,21 @@ class RunTelemetry:
                    client_upload_bytes=client_upload_bytes,
                    wire_dtype=self._wire_dtype())
 
+    def layer_signals_event(self, *, rnd: int, mode: str,
+                            signal_groups: str, groups, sizes,
+                            values: Dict[str, Any]) -> None:
+        """Layer-wise compression attribution for one round (schema
+        v10, telemetry/layer_signals.py computes the per-group vectors
+        on device; the driver fetches them at the signals cadence).
+        ``values`` is the layer_signals_to_host dict — None fields and
+        NaN entries serialize as nulls, never fake zeros."""
+        from commefficient_tpu.telemetry.layer_signals import \
+            LAYER_SIGNAL_KEYS
+        self.event("layer_signals", round=int(rnd), mode=mode,
+                   signal_groups=signal_groups,
+                   groups=list(groups), sizes=list(sizes),
+                   **{k: values.get(k) for k in LAYER_SIGNAL_KEYS})
+
     def client_stats_event(self, *, rnd: int, n_participants: int,
                            quantiles: Dict[str, Any],
                            participation: Dict[str, Any]) -> None:

@@ -20,7 +20,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterable, List, Tuple
 
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 # streams written by older code stay readable: v1 lacks the span /
 # utilization event types (added in v2), v2 lacks client_stats / alert
 # (added in v3), v3 lacks async_round (added in v4), v4 lacks defense
@@ -34,10 +34,13 @@ SCHEMA_VERSION = 9
 # for crash recovery lineage — FIELDS_SINCE_V8), v8 lacks the quantized-
 # wire fields on collectives/signals/bench (wire_dtype and the modeled
 # table-reduce ICI bytes, added in v9 for --wire_dtype int8 —
-# FIELDS_SINCE_V9), but each is otherwise a subset of its successor —
-# so the validator accepts any supported manifest version. A version it
-# does not know is the error, not a version merely older than current.
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, SCHEMA_VERSION)
+# FIELDS_SINCE_V9), v9 lacks the layer_signals event type (the
+# layer-wise compression attribution stream, added in v10 — a new type,
+# no vintage-gated field additions), but each is otherwise a subset of
+# its successor — so the validator accepts any supported manifest
+# version. A version it does not know is the error, not a version
+# merely older than current.
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, SCHEMA_VERSION)
 TELEMETRY_BASENAME = "telemetry.jsonl"
 
 
@@ -221,6 +224,30 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "client_download_bytes": _opt_list,  # per participating client,
         "client_upload_bytes": _opt_list,    # ordered by client_ids
         "wire_dtype": _opt_str,              # v9: the table wire dtype
+    },
+    # layer-wise compression attribution for one round (schema v10,
+    # telemetry/layer_signals.py): per-parameter-group reductions of
+    # the round's dense quantities, one list entry per named group in
+    # ravel order. Masses are squared-L2 energies (additive — per-group
+    # masses sum to the matching whole-vector signal norm squared);
+    # topk_count sums to nnz(update) (= k for the sparsifying modes).
+    # grad_mass/error_mass/hh_overlap are null — never fake zeros —
+    # where the round holds no dense gradient / dense EF / exact
+    # reference (fused-encode and mesh sketch rounds; --signals_exact
+    # off), mirroring the signals NaN contract. Entries inside live
+    # lists may be null too (a group that owns no top-k winner has no
+    # defined hh_overlap).
+    "layer_signals": {
+        "round": _int,
+        "mode": _str,
+        "signal_groups": _str,          # coarse | leaf (the config axis)
+        "groups": _list,                # group names, ravel order
+        "sizes": _list,                 # coordinate counts per group
+        "grad_mass": _opt_list,
+        "update_mass": _opt_list,
+        "topk_count": _opt_list,
+        "error_mass": _opt_list,
+        "hh_overlap": _opt_list,
     },
     # collective inventory of one compiled executable (telemetry/
     # collectives.py): per-kind LAUNCH counts, total payload bytes and

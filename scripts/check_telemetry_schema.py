@@ -48,6 +48,17 @@ _SAMPLE_OVERRIDES = {
     "table_reduce_bytes": 1428.0,
     "client_download_bytes": [4.0],
     "client_upload_bytes": [4.0],
+    # schema-v10 layer_signals: one realistic coarse attribution — a
+    # norm-bias group holding gradient mass but winning none of k (the
+    # starvation signature), hh_overlap null where no winner landed
+    "signal_groups": "coarse",
+    "groups": ["embed", "h0/attn", "h0/norm-bias", "head"],
+    "sizes": [16704, 12288, 384, 650],
+    "grad_mass": [3.1, 5.4, 0.9, 1.2],
+    "update_mass": [1.0, 2.4, 0.0, 0.4],
+    "topk_count": [2.0, 5.0, 0.0, 1.0],
+    "error_mass": [0.4, 0.9, 2.8, 0.2],
+    "hh_overlap": [1.0, 0.8, None, 1.0],
     "spans": [{"name": "data_fetch", "ts": 0.0, "dur_s": 0.01,
                "tid": 0, "depth": 0},
               {"name": "round_dispatch", "ts": 0.01, "dur_s": 0.02,

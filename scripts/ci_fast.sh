@@ -57,6 +57,13 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_wire.py -q \
 env JAX_PLATFORMS=cpu python -m pytest tests/test_sharded_server.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
+# layer-wise compression attribution: a regression here (a broken
+# group partition / conservation law, a per-group collective unroll,
+# lost HLO identity with --signal_groups off, starvation-rule or
+# teleview-fallback drift) fails in seconds, before the full suite
+env JAX_PLATFORMS=cpu python -m pytest tests/test_layer_signals.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
 # preemption-safe rounds: a regression here (lost bitwise crash-resume,
 # checkpoint-integrity fallback drift, telemetry stream clobbering,
 # quarantine state dropped on restart, a leaked watchdog thread) fails

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Layer-wise compression attribution evidence runs (ISSUE 15): the
+# hard-v2 sketch recipe at the flagship 2.6x compression and at the
+# ~10x ROADMAP target, with the schema-v10 layer_signals stream on
+# (--signals_exact + --sketch_fused_encode off keep the dense capture
+# alive so grad_mass and the per-group heavy-hitter overlap are live —
+# the starvation rule measures against gradient mass, never guesses).
+#
+# CPU-scale arms: the FLAGSHIP sketch geometry is kept exactly
+# (d = 6.57M ResNet9+BN, r = 5, k = 50k, c = 500k -> 2.63x; the 10x arm
+# narrows c to 131072 -> 10.0x) — only the schedule is cut to CPU size
+# (local_batch_size 32, 2 epochs of the 4k-image synthetic-hard set vs
+# the committed 48-epoch TPU runs), so the per-group attribution
+# describes the real flagship channel, not a toy. The committed
+# streams + runs/BREAKDOWN_layers.md are the analysis artifact.
+#
+# Usage: scripts/layer_attribution.sh [c26x] [c10x]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+run() {
+    local name=$1; shift
+    echo "=== $name ==="
+    rm -rf "runs/layer_attrib/$name"
+    python cv_train.py --dataset_name CIFAR10 --model ResNet9 --batchnorm \
+      --iid --num_clients 40 --num_workers 8 --local_batch_size 32 \
+      --num_epochs 2 --synthetic_per_class 400 --synthetic_hard \
+      --synthetic_label_noise 0.08 --lr_scale 0.1 --seed 21 \
+      --local_momentum 0.0 --virtual_momentum 0.9 \
+      --mode sketch --error_type virtual \
+      --k 50000 --num_rows 5 --num_blocks 20 --approx_topk \
+      --exact_num_cols --signals_exact --sketch_fused_encode off \
+      --telemetry_every 1 --logdir "runs/layer_attrib/$name" \
+      "$@" 2>&1 | tail -5
+    python scripts/teleview.py layers "runs/layer_attrib/$name"
+}
+
+[ $# -eq 0 ] && set -- c26x c10x
+for arm in "$@"; do
+  case "$arm" in
+    c26x) run c26x --num_cols 500000 ;;   # flagship: d/(r*c) = 2.63x
+    c10x) run c10x --num_cols 131072 ;;   # ROADMAP target: 10.0x
+    *) echo "unknown arm $arm"; exit 1 ;;
+  esac
+done

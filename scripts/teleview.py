@@ -3,15 +3,23 @@
 
 BENCH/MULTICHIP comparisons have been manual JSON spelunking — ``jq``
 one-liners against artifacts whose schema only the writers knew. This
-CLI reads one stream (``summarize``/``alerts``/``clients``), two
-(``diff``), or renders one into a timeline (``timeline``):
+CLI reads one stream (``summarize``/``alerts``/``clients``/
+``layers``), two (``diff``), or renders one into a timeline
+(``timeline``):
 
     python scripts/teleview.py summarize runs/x/telemetry.jsonl
     python scripts/teleview.py alerts runs/x/telemetry.jsonl
     python scripts/teleview.py clients runs/x/telemetry.jsonl
+    python scripts/teleview.py layers runs/x/telemetry.jsonl
     python scripts/teleview.py memory runs/x/telemetry.jsonl
     python scripts/teleview.py diff old/telemetry.jsonl new/telemetry.jsonl
     python scripts/teleview.py timeline runs/x/telemetry.jsonl -o trace.json
+
+``layers`` (schema v10) renders the layer-wise compression attribution
+stream (``layer_signals`` events, telemetry/layer_signals.py): the
+per-group table — coordinate/gradient/update/EF mass shares, top-k win
+share, heavy-hitter overlap, STARVED verdicts at the monitor rule's
+thresholds — and the per-group win-share trend.
 
 ``memory`` (schema v6) renders the per-executable byte inventory
 (``memory_ledger`` events), the residency timeline (enriched ``memory``
@@ -37,9 +45,12 @@ line a crashed writer leaves (see ``load_events``).
 
 ``timeline`` renders the ``span`` event stream (telemetry/tracing.py)
 into a perfetto / chrome-tracing ``trace.json`` — complete ("X") slice
-events per span, plus counter ("C") tracks for MFU, input-wait fraction
-and round loss. Open it at https://ui.perfetto.dev or
-chrome://tracing.
+events per span, plus counter ("C") tracks for MFU, input-wait
+fraction, round loss, and (schema v9) the per-executable table-reduce
+wire: modeled ICI bytes (``table_reduce_bytes:<name>``) and the wire
+dtype's bytes/cell (``wire_dtype_bytes:<name>``) — a quantized wire
+silently re-widening shows as a step in the timeline. Open it at
+https://ui.perfetto.dev or chrome://tracing.
 
 ``diff`` compares two runs and EXITS NONZERO on regression:
 - any collective launch-count increase for a watched executable (the
@@ -51,10 +62,15 @@ chrome://tracing.
   ``--overlap_drop``;
 - the final round/epoch loss growing beyond ``--loss_ratio``x;
 - MFU dropping more than ``--mfu_drop`` (relative) or the input-wait
-  starvation fraction rising more than ``--input_wait_rise`` (absolute,
-  alias ``--starvation_rise``), from the last ``utilization`` event of
-  each run — the round-pipeline regression gate, exercised with its
-  default threshold by ``__graft_entry__.dryrun_multichip``;
+  starvation fraction rising more than ``--input_wait_rise`` (absolute),
+  from the last ``utilization`` event of each run — the round-pipeline
+  regression gate, exercised with its default threshold by
+  ``__graft_entry__.dryrun_multichip``;
+- on schema-v10 streams, the LAYER starvation gap (max over groups
+  above the grad-mass floor of mass share minus top-k win share, final
+  ``layer_signals`` event) rising more than ``--starvation_rise``
+  (absolute) — a parameter group losing the top-k race it used to win
+  (pre-v10 that spelling aliased ``--input_wait_rise``);
 - on async buffered-aggregation streams (schema v4), the final
   ``async_round`` staleness_mean rising more than ``--staleness_rise``
   (absolute, commits-stale units), or its post-commit error_norm
@@ -87,6 +103,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 try:
     # single source of truth when the package is importable...
     from commefficient_tpu.telemetry.clients import CLIENT_STAT_KEYS
+    from commefficient_tpu.telemetry.layer_signals import (
+        LAYER_SIGNAL_KEYS, STARVATION_MASS_SHARE, STARVATION_WIN_SHARE,
+        starved_groups)
     from commefficient_tpu.telemetry.memory_ledger import (
         MEMORY_KEYS, MEMORY_LEDGER_KEYS)
     from commefficient_tpu.telemetry.schema import TELEMETRY_BASENAME
@@ -122,6 +141,32 @@ except ImportError:
         "arithmetic_intensity", "ridge_intensity", "bound",
         "achieved_gbps", "bw_frac", "expected_round_s",
     )
+    LAYER_SIGNAL_KEYS = (
+        "grad_mass", "update_mass", "topk_count", "error_mass",
+        "hh_overlap",
+    )
+    STARVATION_MASS_SHARE = 0.05
+    STARVATION_WIN_SHARE = 0.02
+
+    def starved_groups(groups, grad_mass, topk_count,
+                       mass_share=STARVATION_MASS_SHARE,
+                       win_share=STARVATION_WIN_SHARE):
+        # literal twin of layer_signals.starved_groups (pinned against
+        # the package by tests/test_layer_signals.py): groups holding
+        # > mass_share of the gradient energy but winning < win_share
+        # of the top-k coordinates. Empty when grad_mass is null.
+        if not grad_mass or not topk_count:
+            return []
+        gm = [v if isinstance(v, (int, float)) else 0.0
+              for v in grad_mass]
+        tc = [v if isinstance(v, (int, float)) else 0.0
+              for v in topk_count]
+        tm, tk = sum(gm), sum(tc)
+        if tm <= 0 or tk <= 0:
+            return []
+        return [(str(g), gm[i] / tm, tc[i] / tk)
+                for i, g in enumerate(groups)
+                if gm[i] / tm > mass_share and tc[i] / tk < win_share]
 
 NORM_KEYS = ("grad_norm", "grad_true_norm", "grad_l2estimate",
              "velocity_norm", "error_norm", "error_l2estimate",
@@ -291,6 +336,20 @@ def summarize(events: List[Dict[str, Any]], label: str = "") -> None:
                 continue
             print(f"   {key:18s} first {vals[0]:11.5g} last {vals[-1]:11.5g}"
                   f" min {min(vals):11.5g} max {max(vals):11.5g}")
+
+    lsigs = by_kind(events, "layer_signals")
+    if lsigs:
+        last = lsigs[-1]
+        groups = last.get("groups") or []
+        sv = starved_groups(groups, last.get("grad_mass"),
+                            last.get("topk_count"))
+        print(f"-- layers: {len(lsigs)} records, {len(groups)} "
+              f"{last.get('signal_groups', '?')} groups"
+              + (f"; STARVED last round: "
+                 + " ".join(f"{g}({ms * 100:.1f}% mass, "
+                            f"{ws * 100:.2f}% of k)" for g, ms, ws in sv)
+                 if sv else "; no starved group last round")
+              + " (run `teleview layers` for the table)")
 
     asyncs = by_kind(events, "async_round")
     if asyncs:
@@ -539,6 +598,86 @@ def defense(events: List[Dict[str, Any]]) -> int:
     return 1 if ejected else 0
 
 
+# -------------------------------------------------------------------- layers
+
+
+def _shares(vals) -> Optional[List[Optional[float]]]:
+    """Per-entry share of a per-group mass/count list (None-safe);
+    None when the field is null or carries no mass."""
+    if not vals:
+        return None
+    nums = [v if isinstance(v, (int, float)) else 0.0 for v in vals]
+    total = sum(nums)
+    if total <= 0:
+        return None
+    return [v / total for v in nums]
+
+
+def layers(events: List[Dict[str, Any]]) -> int:
+    """Layer-wise compression attribution report (schema-v10
+    ``layer_signals`` events): the per-group table of the LAST record —
+    coordinate share, dense-gradient mass share, recovered-update mass
+    share, top-k win share, EF mass share, heavy-hitter overlap, and a
+    STARVED verdict (> {mass}% of gradient mass, < {win}% of k — the
+    same thresholds the ``group_starvation`` monitor rule fires on) —
+    plus the first->last win-share trend per group, which is the
+    mechanism trace the adaptive-compression controller consumes."""
+    lsigs = by_kind(events, "layer_signals")
+    if not lsigs:
+        print("no layer_signals events (pre-v10 stream, or "
+              "--signal_groups off / --no_signals)")
+        return 0
+    first, last = lsigs[0], lsigs[-1]
+    groups = [str(g) for g in (last.get("groups") or [])]
+    sizes = last.get("sizes") or []
+    print(f"== layers: {len(lsigs)} records, {len(groups)} "
+          f"{last.get('signal_groups', '?')} groups, mode "
+          f"{last.get('mode', '?')}")
+    d = sum(v for v in sizes if isinstance(v, (int, float))) or 1
+    gshare = _shares(last.get("grad_mass"))
+    ushare = _shares(last.get("update_mass"))
+    kshare = _shares(last.get("topk_count"))
+    eshare = _shares(last.get("error_mass"))
+    hh = last.get("hh_overlap")
+    starved = {g for g, _, _ in starved_groups(
+        groups, last.get("grad_mass"), last.get("topk_count"))}
+
+    def pct(shares, i):
+        if shares is None or i >= len(shares) or shares[i] is None:
+            return "     -"
+        return f"{shares[i] * 100:5.1f}%"
+
+    cshare = [(s / d if isinstance(s, (int, float)) else None)
+              for s in sizes]
+    print("   group                 coords   grad    upd    k-win"
+          "   err     hh")
+    for i, g in enumerate(groups):
+        h = (hh[i] if hh and i < len(hh) else None)
+        print(f"   {g:20s} {pct(cshare, i)}"
+              f" {pct(gshare, i)} {pct(ushare, i)} {pct(kshare, i)}"
+              f" {pct(eshare, i)}"
+              + (f"  {h:5.2f}" if isinstance(h, (int, float)) else "      -")
+              + ("   STARVED" if g in starved else ""))
+    kf, kl = _shares(first.get("topk_count")), kshare
+    if kf and kl and first is not last:
+        print(f"-- k-win share trend (r{first.get('round', '?')} -> "
+              f"r{last.get('round', '?')})")
+        for i, g in enumerate(groups):
+            if i < len(kf) and kf[i] is not None and kl[i] is not None:
+                print(f"   {g:20s} {kf[i] * 100:5.1f}% -> "
+                      f"{kl[i] * 100:5.1f}%")
+    if starved:
+        print(f"-- STARVED groups (> {STARVATION_MASS_SHARE * 100:.0f}% "
+              f"gradient mass, < {STARVATION_WIN_SHARE * 100:.0f}% of k): "
+              + " ".join(sorted(starved)))
+    else:
+        print("-- no starved group in the last record"
+              + ("" if gshare is not None else
+                 " (grad_mass is null — starvation is measured against "
+                 "gradient mass, unavailable on this round's topology)"))
+    return 0
+
+
 # -------------------------------------------------------------------- memory
 
 
@@ -629,6 +768,22 @@ def build_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                            int(s.get("tid") or 0),
                            int(s.get("depth") or 0)))
     counters = []  # (abs_t_s, track_name, value)
+    # wire-width tracks (schema v9, `collectives` events): the modeled
+    # per-device table-reduce ICI bytes and the wire dtype's bytes/cell
+    # per watched executable — a quantized wire silently re-widening is
+    # visible as a step in the timeline, not only in `diff`
+    wire_cell_bytes = {"float32": 4.0, "bfloat16": 2.0, "int8": 1.0}
+    for e in by_kind(events, "collectives"):
+        t = _fin(e.get("t"))
+        if t is None:
+            continue
+        name = str(e.get("name", "?"))
+        trb = _fin(e.get("table_reduce_bytes"))
+        if trb is not None:
+            counters.append((t, f"table_reduce_bytes:{name}", trb))
+        w = wire_cell_bytes.get(str(e.get("wire_dtype")))
+        if w is not None:
+            counters.append((t, f"wire_dtype_bytes:{name}", w))
     for e in by_kind(events, "utilization"):
         t = _fin(e.get("t"))
         if t is None:
@@ -766,10 +921,10 @@ def diff(a: List[Dict[str, Any]], b: List[Dict[str, Any]],
         wa = _fin(ua[-1].get("input_wait_frac"))
         wb = _fin(ub[-1].get("input_wait_frac"))
         if wa is not None and wb is not None \
-                and wb > wa + args.starvation_rise:
+                and wb > wa + args.input_wait_rise:
             problems.append(
                 f"utilization: input_wait_frac {wa:.3f} -> {wb:.3f} "
-                f"(rise > {args.starvation_rise:.2f} — the input "
+                f"(rise > {args.input_wait_rise:.2f} — the input "
                 "pipeline started starving the chip)")
         fa = _fin(ua[-1].get("bw_frac"))
         fb = _fin(ub[-1].get("bw_frac"))
@@ -798,6 +953,34 @@ def diff(a: List[Dict[str, Any]], b: List[Dict[str, Any]],
             f"(> {args.perchip_drop:.0%} relative drop — per-chip "
             "throughput regressed; on a weak-scaling sweep this means "
             "added chips are being taxed instead of adding capacity)")
+
+    def starvation_gap(events):
+        # max per-group starvation gap (mass share minus k-win share,
+        # over groups above the mass floor) of the final layer_signals
+        # event; None when the stream has none or grad_mass is null —
+        # the gate is vacuous-by-absence like every other diff gate
+        ls = by_kind(events, "layer_signals")
+        if not ls:
+            return None
+        e = ls[-1]
+        gm = _shares(e.get("grad_mass"))
+        tc = _shares(e.get("topk_count"))
+        if gm is None or tc is None:
+            return None
+        gaps = [m - w for m, w in zip(gm, tc)
+                if m is not None and w is not None
+                and m > STARVATION_MASS_SHARE]
+        return max(gaps) if gaps else 0.0
+
+    ga, gb = starvation_gap(a), starvation_gap(b)
+    if ga is not None and gb is not None \
+            and gb > ga + args.starvation_rise:
+        problems.append(
+            f"layer_signals: starvation gap (max grad-mass share minus "
+            f"k-win share) {ga:.3f} -> {gb:.3f} (rise > "
+            f"{args.starvation_rise:.2f} — a parameter group is losing "
+            "the top-k race it used to win; the layer-wise compression "
+            "allocation regressed)")
 
     aa, ab = by_kind(a, "async_round"), by_kind(b, "async_round")
     if aa and ab:
@@ -919,13 +1102,23 @@ def main(argv=None) -> int:
     d.add_argument("--mfu_drop", type=float, default=0.15,
                    help="max RELATIVE drop of the final mfu (0.15 = "
                         "15%% slower per peak-FLOP fails)")
-    d.add_argument("--input_wait_rise", "--starvation_rise",
-                   dest="starvation_rise", type=float, default=0.10,
+    d.add_argument("--input_wait_rise", dest="input_wait_rise",
+                   type=float, default=0.10,
                    help="max ABSOLUTE rise of the final input_wait_frac "
-                        "(the round-pipeline starvation gate; "
-                        "--starvation_rise kept as an alias). "
+                        "(the round-pipeline starvation gate). "
                         "dryrun_multichip wires the default against its "
-                        "pipelined-vs-inline streams")
+                        "pipelined-vs-inline streams. (--starvation_rise "
+                        "was an alias of this flag before schema v10; it "
+                        "now gates LAYER starvation — see below)")
+    d.add_argument("--starvation_rise", type=float, default=0.15,
+                   help="max ABSOLUTE rise of the layer-starvation gap "
+                        "(schema-v10 layer_signals streams: max over "
+                        "groups above the grad-mass floor of mass share "
+                        "minus k-win share, from the final record) — a "
+                        "group losing the top-k race it used to win. "
+                        "Pre-v10 this spelling aliased "
+                        "--input_wait_rise; the input-wait gate keeps "
+                        "its primary spelling")
     d.add_argument("--staleness_rise", type=float, default=1.0,
                    help="max ABSOLUTE rise of the final async_round "
                         "staleness_mean (async buffered-aggregation "
@@ -966,6 +1159,11 @@ def main(argv=None) -> int:
                         help="per-client population trends from the "
                              "client_stats stream")
     cl.add_argument("path")
+    ly = sub.add_parser("layers",
+                        help="layer-wise compression attribution table "
+                             "and per-group win-share trend from the "
+                             "schema-v10 layer_signals stream")
+    ly.add_argument("path")
     de = sub.add_parser("defense",
                         help="robustness report from the schema-v5 "
                              "defense stream (exit 1 on ejections)")
@@ -990,6 +1188,8 @@ def main(argv=None) -> int:
         return alerts(load_events(args.path))
     if args.cmd == "clients":
         return clients(load_events(args.path))
+    if args.cmd == "layers":
+        return layers(load_events(args.path))
     if args.cmd == "defense":
         return defense(load_events(args.path))
     if args.cmd == "memory":
