@@ -336,6 +336,16 @@ class FedConfig:
     # the jitted round; like signals they are also auto-dropped under
     # --no_telemetry (no hot-path work for a stream nobody reads).
     client_stats: bool = True
+    # participation-ledger backing (telemetry/population.py): "off" =
+    # the exact per-client host dict (O(population) memory and
+    # checkpoint sidecar), "on" = the bounded-memory sketch ledger
+    # (count-min counts, space-saving heavy hitters, KMV distinct
+    # sample, P2 stream quantiles — <= 8 MiB regardless of population),
+    # "auto" = exact below 10^5 registered clients, sketch at/above.
+    # Event fields are identical in both modes; the `estimated` flag
+    # (client_stats + population events, schema v11) says which wrote
+    # them — the sketch never fakes exactness.
+    population_sketch: str = "auto"
     # online anomaly monitor (telemetry/health.py) action when a rule
     # fires: "log" = alert event only; "warn" = + stderr line;
     # "checkpoint" = + one-shot flight-recorder bundle (FedState snapshot
@@ -714,6 +724,10 @@ class FedConfig:
             raise ValueError(
                 f"--signal_groups {self.signal_groups!r} not in "
                 "('coarse', 'leaf', 'off')")
+        if self.population_sketch not in ("auto", "on", "off"):
+            raise ValueError(
+                f"--population_sketch {self.population_sketch!r} not in "
+                "('auto', 'on', 'off')")
         assert self.telemetry_every >= -1, self.telemetry_every
         assert self.alert_action in ALERT_ACTIONS, self.alert_action
         assert self.alert_window >= 4, self.alert_window
@@ -1128,6 +1142,15 @@ def add_args(parser: argparse.ArgumentParser, default_lr: Optional[float] = None
                    help="drop the per-client population statistics "
                         "(quantile summaries + participation ledger) "
                         "from the jitted round step")
+    p.add_argument("--population_sketch", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="participation-ledger backing (telemetry/"
+                        "population.py): on = bounded-memory streaming "
+                        "sketches (<= 8 MiB at any population size, "
+                        "fields marked estimated), off = exact per-"
+                        "client dict (O(population) memory), auto = "
+                        "exact below 1e5 registered clients, sketch "
+                        "at/above")
     p.add_argument("--alert_action", choices=ALERT_ACTIONS, default="log",
                    help="anomaly-monitor action on a fired rule: log = "
                         "alert event only; warn = + stderr; checkpoint = "

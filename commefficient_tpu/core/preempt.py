@@ -37,6 +37,7 @@ library: no jitted code changes, no HLO difference with the layer off
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import sys
@@ -289,6 +290,15 @@ def with_retries(fn: Callable[[], Any], *, attempts: int = 3,
 
 # ------------------------------------------------------- ledger persistence
 
+# hard cap on the serialized participation-ledger sidecar (it rides the
+# checkpoint's meta.json, which is read whole at every resume). The
+# sketch ledger's state is ~3.5 MiB at ANY population; only the exact
+# ledger can grow past this — at roughly 2x10^5 seen clients — and the
+# guard names the remedy instead of silently bloating every checkpoint.
+# Env-overridable for deliberately exact large-universe runs.
+LEDGER_SIDECAR_MAX_BYTES = int(os.environ.get(
+    "COMMEFF_LEDGER_SIDECAR_MAX_BYTES", 8 * 1024 * 1024))
+
 
 def collect_ledger_state(qledger=None, participation=None, monitor=None,
                          telemetry=None) -> Dict[str, Any]:
@@ -298,12 +308,29 @@ def collect_ledger_state(qledger=None, participation=None, monitor=None,
     (how far the flight-recorder ring had advanced — a resumed bundle
     reader can tell a pre-restart event from a post-restart one). All
     JSON-serializable; everything restores via
-    :func:`restore_ledger_state`."""
+    :func:`restore_ledger_state`.
+
+    Fails loudly (ValueError) when the participation ledger's state
+    exceeds :data:`LEDGER_SIDECAR_MAX_BYTES` — the exact ledger at
+    population scale. The error names ``--population_sketch on`` (the
+    bounded-memory backing, telemetry/population.py) as the remedy."""
     out: Dict[str, Any] = {}
     if qledger is not None:
         out["quarantine"] = qledger.state_dict()
     if participation is not None:
-        out["participation"] = participation.state_dict()
+        part = participation.state_dict()
+        nbytes = len(json.dumps(part).encode())
+        if nbytes > LEDGER_SIDECAR_MAX_BYTES:
+            raise ValueError(
+                f"participation-ledger checkpoint sidecar is "
+                f"{nbytes / 2**20:.1f} MiB (> "
+                f"{LEDGER_SIDECAR_MAX_BYTES / 2**20:.1f} MiB cap): the "
+                f"exact per-client ledger does not scale to this "
+                f"universe ({getattr(participation, 'num_clients', '?')} "
+                f"registered clients). Pass --population_sketch on (or "
+                f"auto) for the bounded-memory sketch ledger, or raise "
+                f"COMMEFF_LEDGER_SIDECAR_MAX_BYTES to keep exact state.")
+        out["participation"] = part
     if monitor is not None:
         out["monitor"] = monitor.state_dict()
     if telemetry is not None:

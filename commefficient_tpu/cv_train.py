@@ -40,8 +40,8 @@ from commefficient_tpu.telemetry import (ProfilerWindow, UtilizationTracker,
                                          layer_signals_to_host,
                                          signals_to_host, tracing)
 from commefficient_tpu.telemetry import maybe_create as make_telemetry
-from commefficient_tpu.telemetry.clients import (ParticipationLedger,
-                                                 client_stats_to_host)
+from commefficient_tpu.telemetry.clients import (client_stats_to_host,
+                                                 make_ledger)
 from commefficient_tpu.telemetry.health import AnomalyMonitor, FlightRecorder
 from commefficient_tpu.utils import (
     PiecewiseLinear,
@@ -380,8 +380,12 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
         if cfg.client_stats:
             # host-side participation accounting over the whole client
             # universe — observes the sampler's (host-resident) ids, so
-            # it costs no device traffic and runs EVERY round
-            ledger = ParticipationLedger(train_ds.num_clients)
+            # it costs no device traffic and runs EVERY round. The
+            # backing is policy-selected (telemetry/clients.make_ledger):
+            # exact dict for small universes, bounded-memory sketches
+            # (telemetry/population.py) at population scale
+            ledger = make_ledger(train_ds.num_clients,
+                                 cfg.population_sketch)
     # async buffered aggregation (core/async_agg.py): the round splits
     # into dispatch-time cohort compute and buffer-goal commits; the
     # scenario engine (data/scenarios.py) decides each cohort's
@@ -780,6 +784,11 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                     fin = np.asarray(metrics["client_finite"])
                     struck = qledger.observe(
                         global_round, np.asarray(rnd.client_ids), fin)
+                    if ledger is not None and struck:
+                        # the population ledger's quarantine-strike
+                        # heavy-hitter stream: which clients keep
+                        # uploading garbage, at any universe size
+                        ledger.observe_strikes(struck)
                     for cid in struck:
                         if cid in qledger.ejected:
                             what = "EJECTED (strikes exhausted)"
@@ -890,13 +899,28 @@ def train(cfg: FedConfig, runtime: FedRuntime, state, train_ds, val_ds,
                             n_part = (int((np.asarray(obs_n) > 0).sum())
                                       if async_agg is not None
                                       else len(np.asarray(rnd.client_ids)))
+                            quantiles = client_stats_to_host(
+                                metrics["client_stats"], rnd.client_ids)
+                            # the loss-argmax heavy-hitter stream: the
+                            # round's worst client id, already computed
+                            # on device for the quantile record
+                            ledger.observe_loss_argmax(
+                                (quantiles.get("loss") or {})
+                                .get("argmax_client"))
                             telemetry.client_stats_event(
                                 rnd=global_round,
                                 n_participants=n_part,
-                                quantiles=client_stats_to_host(
-                                    metrics["client_stats"],
-                                    rnd.client_ids),
+                                quantiles=quantiles,
                                 participation=ledger.snapshot(
+                                    global_round))
+                        if ledger is not None:
+                            # population-scale participation summary
+                            # (schema v11): the ledger's full universe
+                            # view — exact or sketch-estimated, its
+                            # `estimated` flag says which; feeds the
+                            # coverage_stall / hh_churn monitor rules
+                            telemetry.population_event(
+                                snapshot=ledger.population_snapshot(
                                     global_round))
                         if defense_on:
                             # schema-v5 defense record: device scalars

@@ -33,7 +33,7 @@ the signals.py convention.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
@@ -148,28 +148,56 @@ class ParticipationLedger:
     (rounds since each seen client last participated).
     """
 
+    estimated = False
+
     def __init__(self, num_clients: int):
         self.num_clients = max(int(num_clients), 1)
         self._samples: Dict[int, float] = {}
         self._last_round: Dict[int, int] = {}
+        self._loss_wins: Dict[int, float] = {}
+        self._strikes: Dict[int, float] = {}
+        from commefficient_tpu.telemetry.population import P2Quantile
+        self._p2 = {"obs_count_p50": P2Quantile(0.50),
+                    "obs_count_p95": P2Quantile(0.95),
+                    "gap_p50": P2Quantile(0.50),
+                    "gap_p95": P2Quantile(0.95)}
 
     def observe(self, rnd: int, client_ids, samples_per_slot=None) -> None:
-        ids = np.asarray(client_ids).reshape(-1)
-        counts = (np.asarray(samples_per_slot, np.float64).reshape(-1)
-                  if samples_per_slot is not None
-                  else np.ones(len(ids)))
-        for c, n in zip(ids.tolist(), counts.tolist()):
-            if n <= 0:
-                # a zero-sample slot did not participate: the async
-                # scenario engine's partial-participation masking zeroes
-                # whole slots (data/scenarios.py), and crediting them
-                # would reset the client's staleness without it having
-                # contributed anything. Sync rounds never produce these
-                # (the sampler only yields slots with data).
-                continue
+        # zero-sample slots did not participate: the async scenario
+        # engine's partial-participation masking zeroes whole slots
+        # (data/scenarios.py), and crediting them would reset the
+        # client's staleness without it having contributed anything.
+        # Sync rounds never produce these (the sampler only yields
+        # slots with data). _aggregate drops them, dedups repeated ids
+        # within the batch and returns ascending unique ids — the bulk
+        # form of the old per-slot loop (equivalence pinned in
+        # tests/test_population.py).
+        from commefficient_tpu.telemetry.population import _aggregate
+        uniq, sums = _aggregate(client_ids, samples_per_slot)
+        rnd = int(rnd)
+        for c, n in zip(uniq.tolist(), sums.tolist()):
             c = int(c)
+            prev = self._last_round.get(c)
+            if prev is not None:
+                self._p2["gap_p50"].add(rnd - prev)
+                self._p2["gap_p95"].add(rnd - prev)
             self._samples[c] = self._samples.get(c, 0.0) + float(n)
-            self._last_round[c] = int(rnd)
+            self._last_round[c] = rnd
+            self._p2["obs_count_p50"].add(n)
+            self._p2["obs_count_p95"].add(n)
+
+    def observe_loss_argmax(self, client_id: Optional[int]) -> None:
+        """One round's highest-loss client (the client_stats
+        quantiles[...]["argmax_client"] channel); weight 1 per round."""
+        if client_id is not None:
+            c = int(client_id)
+            self._loss_wins[c] = self._loss_wins.get(c, 0.0) + 1.0
+
+    def observe_strikes(self, client_ids: Sequence[int]) -> None:
+        """Quarantine strikes this round (core/quarantine.py ledger)."""
+        for c in client_ids:
+            c = int(c)
+            self._strikes[c] = self._strikes.get(c, 0.0) + 1.0
 
     @property
     def distinct(self) -> int:
@@ -183,19 +211,38 @@ class ParticipationLedger:
             "samples": {str(c): n for c, n in self._samples.items()},
             "last_round": {str(c): r
                            for c, r in self._last_round.items()},
+            "loss_wins": {str(c): n for c, n in self._loss_wins.items()},
+            "strikes": {str(c): n for c, n in self._strikes.items()},
+            "p2": {k: v.state_dict() for k, v in self._p2.items()},
         }
 
     def load_state_dict(self, d: Dict[str, Any]) -> None:
+        if d and d.get("sketch"):
+            raise ValueError(
+                "checkpoint ledger sidecar holds SKETCH participation "
+                "state (--population_sketch on) but this run uses the "
+                "exact ledger; resume with the ledger mode the "
+                "checkpoint was written under (or drop the sidecar to "
+                "start coverage accounting fresh)")
         self._samples = {int(c): float(n)
                          for c, n in (d.get("samples") or {}).items()}
         self._last_round = {int(c): int(r)
                             for c, r in (d.get("last_round") or {}).items()}
+        # pre-v11 sidecars legitimately lack the heavy-hitter / P2 keys
+        self._loss_wins = {int(c): float(n)
+                           for c, n in (d.get("loss_wins") or {}).items()}
+        self._strikes = {int(c): float(n)
+                         for c, n in (d.get("strikes") or {}).items()}
+        for k, v in (d.get("p2") or {}).items():
+            if k in self._p2:
+                self._p2[k].load_state_dict(v)
 
     def snapshot(self, rnd: int) -> Dict[str, Any]:
         if not self._samples:
             return {"coverage": 0.0, "distinct_clients": 0,
                     "counts_p50": None, "counts_max": None,
-                    "staleness_p50": None, "staleness_max": None}
+                    "staleness_p50": None, "staleness_max": None,
+                    "estimated": False}
         counts = np.fromiter(self._samples.values(), np.float64)
         stale = np.asarray([rnd - lr for lr in self._last_round.values()],
                            np.float64)
@@ -206,4 +253,78 @@ class ParticipationLedger:
             "counts_max": float(counts.max()),
             "staleness_p50": float(np.percentile(stale, 50)),
             "staleness_max": float(stale.max()),
+            "estimated": False,
         }
+
+    def memory_bytes(self) -> int:
+        """Resident-footprint model: ~76B per dict entry (int key +
+        float value + slot), 4 dicts — O(population), which is exactly
+        why :mod:`~commefficient_tpu.telemetry.population` exists."""
+        n = (len(self._samples) + len(self._last_round)
+             + len(self._loss_wins) + len(self._strikes))
+        return n * 76 + 4 * 256
+
+    def population_snapshot(self, rnd: int) -> Dict[str, Any]:
+        """The schema-v11 ``population`` event body — same fields as
+        PopulationLedger.population_snapshot, exact values, sketch
+        parameters null, ``estimated: False``. The obs_count/gap
+        quantiles are P2 estimates in BOTH modes (the per-participation
+        streams are unbounded); everything else here is exact."""
+        def top10(d: Dict[int, float]):
+            order = sorted(d, key=lambda c: (-d[c], c))[:10]
+            return [[int(c), float(d[c])] for c in order]
+
+        base = self.snapshot(rnd)
+        have = bool(self._samples)
+        counts = (np.fromiter(self._samples.values(), np.float64)
+                  if have else None)
+        stale = (np.asarray([rnd - lr
+                             for lr in self._last_round.values()],
+                            np.float64) if have else None)
+        return {
+            "round": int(rnd),
+            "estimated": False,
+            "registered": self.num_clients,
+            "distinct": float(len(self._samples)),
+            "coverage": base["coverage"],
+            "counts_p50": base["counts_p50"],
+            "counts_p95": (float(np.percentile(counts, 95))
+                           if have else None),
+            "counts_max": base["counts_max"],
+            "staleness_p50": base["staleness_p50"],
+            "staleness_p95": (float(np.percentile(stale, 95))
+                              if have else None),
+            "staleness_max": base["staleness_max"],
+            "obs_count_p50": self._p2["obs_count_p50"].value(),
+            "obs_count_p95": self._p2["obs_count_p95"].value(),
+            "gap_p50": self._p2["gap_p50"].value(),
+            "gap_p95": self._p2["gap_p95"].value(),
+            "top_sampled": top10(self._samples),
+            "top_loss": top10(self._loss_wins),
+            "top_strikes": top10(self._strikes),
+            "memory_bytes": float(self.memory_bytes()),
+            "cm_epsilon": None,
+            "cm_delta": None,
+            "hh_k": None,
+            "sample_size": None,
+        }
+
+
+def make_ledger(num_clients: int, population_sketch: str = "auto", *,
+                seed: int = 0):
+    """Ledger construction policy for the drivers: ``auto`` uses the
+    exact ledger below :data:`~commefficient_tpu.telemetry.population.
+    AUTO_SKETCH_THRESHOLD` registered clients and the bounded-memory
+    sketch ledger at/above it; ``on``/``off`` force the choice. Both
+    ledgers emit identical event fields; only ``estimated`` differs."""
+    from commefficient_tpu.telemetry.population import (
+        AUTO_SKETCH_THRESHOLD, PopulationLedger)
+    if population_sketch not in ("auto", "on", "off"):
+        raise ValueError(f"population_sketch must be auto|on|off, "
+                         f"got {population_sketch!r}")
+    sketch = (population_sketch == "on"
+              or (population_sketch == "auto"
+                  and int(num_clients) >= AUTO_SKETCH_THRESHOLD))
+    if sketch:
+        return PopulationLedger(num_clients, seed=seed)
+    return ParticipationLedger(num_clients)

@@ -51,7 +51,14 @@ from typing import Any, Dict, List, Optional
 SEVERITIES = ("info", "warn", "critical")
 # event kinds RunTelemetry forwards to an attached monitor
 MONITORED_KINDS = ("round", "signals", "utilization", "client_stats",
-                   "async_round", "defense", "memory", "layer_signals")
+                   "async_round", "defense", "memory", "layer_signals",
+                   "population")
+
+# coverage_stall: consecutive population events with no distinct-
+# participant growth (while rounds advance and the universe is not yet
+# covered) before the rule fires — shared with `teleview diff
+# --coverage_stall`
+COVERAGE_STALL_WINDOW = 5
 
 # The rule table: each rule watches ONE field of ONE event kind.
 # kind="z" fires on a robust z-score breach of the rolling history
@@ -140,6 +147,22 @@ RULES = (
     # against gradient mass, never guessed from the update side.
     dict(name="group_starvation", event="layer_signals", field="topk_count",
          kind="starvation", severity="warn"),
+    # population-scale observability (schema v11, telemetry/
+    # population.py): coverage_stall — distinct-participant growth
+    # flatlining across COVERAGE_STALL_WINDOW consecutive population
+    # events while rounds advance and the universe is not yet covered
+    # (a stuck sampler shard at 10^6 clients looks exactly like healthy
+    # training on every OTHER stream); hh_churn — the most-sampled
+    # heavy-hitter set turning over anomalously fast (robust z on the
+    # Jaccard turnover between consecutive top_sampled sets — a churn
+    # burst is the drifted-sampler / hijacked-cohort signature). The
+    # absolute MAD floor keeps single-slot rotation in an otherwise
+    # stable set (turnover ~0.1 over a 10-entry list) from firing on a
+    # constant-zero history.
+    dict(name="coverage_stall", event="population", field="distinct",
+         kind="coverage_stall", severity="warn"),
+    dict(name="hh_churn", event="population", field="top_sampled",
+         kind="hh_churn", severity="warn", mad_floor_abs=0.05),
 )
 
 
@@ -224,6 +247,12 @@ class AnomalyMonitor:
         # observations the starvation predicate held (layer_signals.py
         # starved_groups); a clean observation breaks the streak
         self._starve: Dict[str, int] = {}
+        # coverage_stall state: the last population event's distinct/
+        # round and the current no-growth streak
+        self._cov: Dict[str, Any] = {}
+        # hh_churn state: the previous population event's top_sampled
+        # id set (None until one has been seen)
+        self._prev_hh: Optional[set] = None
         self.alerts: List[Dict[str, Any]] = []
         self.nonfinite_counts: Dict[str, int] = {}
         self.n_observed = 0
@@ -303,6 +332,77 @@ class AnomalyMonitor:
                         median=round(mass_share, 6), mad=None,
                         window=STARVATION_WINDOW, action=self.action,
                         starved=[list(r) for r in ripe])
+            elif rule["kind"] == "coverage_stall":
+                # distinct-participant growth flatlining while rounds
+                # advance and coverage has headroom: the sampler has
+                # stopped reaching new clients. Streak state persists
+                # across restarts (state_dict), like the starvation
+                # streaks — a stall straddling a resume keeps counting.
+                cov = fields.get("coverage")
+                covered = (isinstance(cov, (int, float))
+                           and not isinstance(cov, bool)
+                           and float(cov) >= 0.999)
+                st = self._cov
+                if numeric:
+                    grew = (st.get("distinct") is None
+                            or float(value) > float(st["distinct"]))
+                    advanced = rnd > st.get("round", -1)
+                    if covered or grew or not advanced:
+                        st["streak"] = 0
+                    else:
+                        st["streak"] = int(st.get("streak", 0)) + 1
+                    st["distinct"] = float(value)
+                    st["round"] = rnd
+                    if (st["streak"] >= COVERAGE_STALL_WINDOW
+                            and quiet <= 0):
+                        alert = dict(
+                            round=rnd, rule=name,
+                            severity=rule["severity"],
+                            metric="population.coverage_stall",
+                            value=(float(cov)
+                                   if isinstance(cov, (int, float))
+                                   and not isinstance(cov, bool)
+                                   else None),
+                            zscore=None, median=None, mad=None,
+                            window=COVERAGE_STALL_WINDOW,
+                            action=self.action)
+                        st["streak"] = 0
+            elif rule["kind"] == "hh_churn":
+                # Jaccard turnover between consecutive top_sampled
+                # heavy-hitter sets, z-scored against its own rolling
+                # history (the churn value — not the raw list — is the
+                # monitored scalar; it builds history under its own
+                # metric name, entered AFTER detection like every
+                # other history)
+                top = fields.get("top_sampled") or []
+                ids = {e[0] for e in top
+                       if isinstance(e, (list, tuple)) and e}
+                if ids:
+                    cmetric = "population.hh_turnover"
+                    chist = self._hist.setdefault(
+                        cmetric, deque(maxlen=self.window))
+                    if self._prev_hh:
+                        union = len(ids | self._prev_hh)
+                        turnover = (1.0 - len(ids & self._prev_hh) / union
+                                    if union else 0.0)
+                        if len(chist) >= self.min_points and quiet <= 0:
+                            stats = robust_z(
+                                turnover, list(chist),
+                                mad_floor_abs=rule.get("mad_floor_abs",
+                                                       0.0))
+                            if stats["zscore"] > self.z_thresh:
+                                alert = dict(
+                                    round=rnd, rule=name,
+                                    severity=rule["severity"],
+                                    metric=cmetric,
+                                    value=round(turnover, 6),
+                                    zscore=round(stats["zscore"], 4),
+                                    median=stats["median"],
+                                    mad=stats["mad"],
+                                    window=len(chist),
+                                    action=self.action)
+                        chist.append(turnover)
+                    self._prev_hh = ids
             elif rule["kind"] == "nonfinite":
                 # only a metric that WAS numeric turning null is a
                 # precursor; an always-null field is merely N/A
@@ -362,6 +462,12 @@ class AnomalyMonitor:
             # group_starvation streaks: a starvation window straddling
             # a restart must keep counting, not restart cold
             "starve": dict(self._starve),
+            # population rules: the coverage_stall streak and the
+            # previous heavy-hitter set (same straddle-the-restart
+            # argument; pre-v11 sidecars legitimately lack both)
+            "cov": dict(self._cov),
+            "prev_hh": (sorted(self._prev_hh)
+                        if self._prev_hh is not None else None),
         }
 
     def load_state_dict(self, d: Dict[str, Any]) -> None:
@@ -375,6 +481,9 @@ class AnomalyMonitor:
         self.n_observed = int(d.get("n_observed", 0))
         self._starve = {g: int(n)
                         for g, n in (d.get("starve") or {}).items()}
+        self._cov = dict(d.get("cov") or {})
+        prev = d.get("prev_hh")
+        self._prev_hh = set(prev) if prev is not None else None
 
     # --------------------------------------------------------------- actions
 

@@ -482,6 +482,12 @@ class RunTelemetry:
                    n_participants=int(n_participants),
                    quantiles=quantiles, **participation)
 
+    def population_event(self, *, snapshot: Dict[str, Any]) -> None:
+        """Population-scale participation summary (schema v11): the
+        ledger's population_snapshot dict — sketch-estimated or exact,
+        its ``estimated`` flag says which (telemetry/population.py)."""
+        self.event("population", **snapshot)
+
     def async_round_event(self, *, rec: Dict[str, Any], lr: float,
                           loss: Optional[float] = None,
                           with_device: bool = False) -> None:

@@ -20,7 +20,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterable, List, Tuple
 
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 # streams written by older code stay readable: v1 lacks the span /
 # utilization event types (added in v2), v2 lacks client_stats / alert
 # (added in v3), v3 lacks async_round (added in v4), v4 lacks defense
@@ -36,11 +36,14 @@ SCHEMA_VERSION = 10
 # table-reduce ICI bytes, added in v9 for --wire_dtype int8 —
 # FIELDS_SINCE_V9), v9 lacks the layer_signals event type (the
 # layer-wise compression attribution stream, added in v10 — a new type,
-# no vintage-gated field additions), but each is otherwise a subset of
-# its successor — so the validator accepts any supported manifest
-# version. A version it does not know is the error, not a version
-# merely older than current.
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, SCHEMA_VERSION)
+# no vintage-gated field additions), v10 lacks the population event
+# type and the client_stats `estimated` flag (population-scale sketch
+# observability, added in v11 — FIELDS_SINCE_V11), but each is
+# otherwise a subset of its successor — so the validator accepts any
+# supported manifest version. A version it does not know is the error,
+# not a version merely older than current.
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10,
+                             SCHEMA_VERSION)
 TELEMETRY_BASENAME = "telemetry.jsonl"
 
 
@@ -345,6 +348,48 @@ EVENT_FIELDS: Dict[str, Dict[str, Any]] = {
         "counts_max": _opt_num,
         "staleness_p50": _opt_num,    # rounds since last participation
         "staleness_max": _opt_num,
+        # schema v11: whether the participation fields are sketch
+        # estimates (--population_sketch; telemetry/population.py) —
+        # the ledger never fakes exactness
+        "estimated": _bool,
+    },
+    # population-scale participation summary (schema v11, telemetry/
+    # population.py + the exact ledger's population_snapshot): the
+    # ledger's full view of the client universe at the record cadence.
+    # In sketch mode (estimated=true) distinct/coverage come from a KMV
+    # bottom-S estimator, counts/staleness quantiles from its uniform
+    # distinct-client sample (DKW rank bound), counts via a count-min
+    # sketch whose (epsilon, delta) ride along, and the top_* lists are
+    # space-saving top-K over the most-sampled / loss-argmax /
+    # quarantine-strike streams ([id, count] pairs, count an upper
+    # estimate). obs_count/gap quantiles are P2 estimates of the
+    # per-participation sample-count and staleness-at-participation
+    # streams in BOTH modes; sketch parameters are null in exact mode —
+    # never fake values
+    "population": {
+        "round": _int,
+        "estimated": _bool,
+        "registered": _int,           # configured client universe size
+        "distinct": _num,             # distinct-participant (estimate)
+        "coverage": _num,
+        "counts_p50": _opt_num,       # per-seen-client cumulative counts
+        "counts_p95": _opt_num,
+        "counts_max": _opt_num,
+        "staleness_p50": _opt_num,    # rounds since last participation
+        "staleness_p95": _opt_num,
+        "staleness_max": _opt_num,
+        "obs_count_p50": _opt_num,    # per-participation sample counts
+        "obs_count_p95": _opt_num,
+        "gap_p50": _opt_num,          # staleness at participation
+        "gap_p95": _opt_num,
+        "top_sampled": _list,         # [[client_id, count], ...] desc
+        "top_loss": _list,
+        "top_strikes": _list,
+        "memory_bytes": _num,         # ledger resident footprint model
+        "cm_epsilon": _opt_num,       # count-min e/width; null if exact
+        "cm_delta": _opt_num,         # count-min e^-depth; null if exact
+        "hh_k": _opt_num,             # space-saving capacity; null if exact
+        "sample_size": _opt_num,      # KMV sample size; null if exact
     },
     # one async buffered-aggregation commit (core/async_agg.py): which
     # cohorts merged, their measured staleness (commits between dispatch
@@ -495,6 +540,13 @@ FIELDS_SINCE_V9: Dict[str, Tuple[str, ...]] = {
     "bench": ("wire_dtype",),
 }
 
+# fields ADDED in schema v11 (population-scale sketch observability:
+# the participation fields may now be estimates, and the flag says so)
+# — same vintage-gated requirement
+FIELDS_SINCE_V11: Dict[str, Tuple[str, ...]] = {
+    "client_stats": ("estimated",),
+}
+
 
 def validate_event(obj: Any,
                    version: int = SCHEMA_VERSION) -> List[str]:
@@ -522,6 +574,7 @@ def validate_event(obj: Any,
     v7_only = FIELDS_SINCE_V7.get(kind, ())
     v8_only = FIELDS_SINCE_V8.get(kind, ())
     v9_only = FIELDS_SINCE_V9.get(kind, ())
+    v11_only = FIELDS_SINCE_V11.get(kind, ())
     for field, pred in spec.items():
         if field not in obj:
             if version < 6 and field in v6_only:
@@ -531,6 +584,8 @@ def validate_event(obj: Any,
             if version < 8 and field in v8_only:
                 continue
             if version < 9 and field in v9_only:
+                continue
+            if version < 11 and field in v11_only:
                 continue
             problems.append(f"{kind}: missing field {field!r}")
         elif not pred(obj[field]):

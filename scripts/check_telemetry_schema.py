@@ -149,6 +149,27 @@ _SAMPLE_OVERRIDES = {
     "checkpoint": "./checkpoint/ResNet9/ckpt_000002_r000005_preempt",
     "prior_stream": "cv_train-1200-18c2a9e77b3",
     "prior_events": 412,
+    # population (schema v11): one realistic sketch-estimated summary —
+    # half the registered fleet seen, the three heavy-hitter tables as
+    # [id, count] pairs, the count-min (eps, delta) the counts carry
+    # (telemetry/population.py; `estimated` also rides client_stats)
+    "estimated": True,
+    "registered": 16,
+    "distinct": 8.0,
+    "counts_p95": 14.0,
+    "staleness_p95": 2.0,
+    "obs_count_p50": 8.0,
+    "obs_count_p95": 12.0,
+    "gap_p50": 2.0,
+    "gap_p95": 4.0,
+    "top_sampled": [[3, 9], [7, 8]],
+    "top_loss": [[3, 4]],
+    "top_strikes": [],
+    "memory_bytes": 3468800.0,
+    "cm_epsilon": 4.15e-05,
+    "cm_delta": 0.0183,
+    "hh_k": 256,
+    "sample_size": 4096,
     # alert: a fired statistical rule
     "rule": "loss_spike",
     "severity": "warn",

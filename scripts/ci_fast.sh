@@ -72,6 +72,14 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_layer_signals.py -q \
 env JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
+# population-scale observability: a regression here (a drifted count-min
+# or heavy-hitter bound, broken sketch/exact snapshot parity, a
+# non-deterministic sidecar that loses bitwise crash-resume, the sidecar
+# size guard or the teleview literal fallbacks drifting) fails in
+# seconds, before the full suite
+env JAX_PLATFORMS=cpu python -m pytest tests/test_population.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Population-scale observability evidence (PR 16): memory and estimate
+accuracy of the sketch-backed ledger (telemetry/population.py) against
+the exact ledger, swept over population sizes 10^4 -> 10^6 on the SAME
+deterministic zipf-skewed sampler stream shape the dryrun gate uses.
+
+For every population size it records the sketch ledger's measured
+footprint (the documented ``memory_bytes`` accounting AND the
+serialized checkpoint-sidecar bytes — the number the PR-13 size guard
+caps) plus, where an exact control is feasible, the realized estimator
+errors next to their documented bounds: count-min max/mean overcount
+vs eps*N, KMV distinct relative error vs ~1/sqrt(S), the coverage gap,
+and heavy-hitter recall for every id above the N/K guarantee line.
+
+Also writes a schema-v11 telemetry stream carrying ``population``
+events from BOTH ledger modes over the 10^4 arm, so the committed
+artifact exercises `teleview population` and the `diff
+--coverage_stall` gate end to end. Host-only numpy — no jax, no
+devices; results land in runs/population/.
+
+    python scripts/population_scale.py [--out runs/population]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from commefficient_tpu.telemetry.clients import ParticipationLedger  # noqa: E402
+from commefficient_tpu.telemetry.population import (  # noqa: E402
+    MEMORY_BUDGET_BYTES, PopulationLedger)
+
+ROUNDS, SLOTS, SEED = 300, 512, 0xB16
+EXACT_CEILING = 200_000  # above this the exact control itself is the liability
+
+
+def stream(rs, num_clients):
+    hot = rs.zipf(1.5, SLOTS // 2) % num_clients
+    cold = rs.randint(0, num_clients, SLOTS - SLOTS // 2)
+    ids = np.concatenate([hot, cold]).astype(np.int64)
+    return ids, rs.randint(1, 9, SLOTS).astype(np.int64)
+
+
+def sweep_one(num_clients):
+    rs = np.random.RandomState(SEED)
+    sk = PopulationLedger(num_clients, seed=7)
+    exact = num_clients <= EXACT_CEILING
+    true = np.zeros(num_clients, np.float64) if exact else None
+    t0 = time.perf_counter()
+    for rnd in range(1, ROUNDS + 1):
+        ids, w = stream(rs, num_clients)
+        sk.observe(rnd, ids, w)
+        sk.observe_loss_argmax(int(ids[0]))
+        if true is not None:
+            np.add.at(true, ids, w.astype(np.float64))
+    ingest_s = time.perf_counter() - t0
+    sidecar = json.dumps(sk.state_dict()).encode()
+    snap = sk.population_snapshot(ROUNDS)
+    row = {
+        "num_clients": num_clients,
+        "rounds": ROUNDS,
+        "slots": SLOTS,
+        "ingest_s": round(ingest_s, 3),
+        "memory_bytes": sk.memory_bytes(),
+        "sidecar_bytes": len(sidecar),
+        "budget_bytes": MEMORY_BUDGET_BYTES,
+        "distinct_est": snap["distinct"],
+        "coverage_est": snap["coverage"],
+        "counts_p50_est": snap["counts_p50"],
+        "cm_epsilon": snap["cm_epsilon"],
+        "cm_delta": snap["cm_delta"],
+    }
+    if true is not None:
+        n = float(true.sum())
+        est = sk.participation_count(np.arange(num_clients, dtype=np.int64))
+        over = est - true
+        assert np.all(over >= -1e-9), "count-min undercounted"
+        floor = n / sk._hh_sampled.k
+        heavy = np.nonzero(true > floor)[0]
+        held = sum(int(c) in sk._hh_sampled._counts for c in heavy.tolist())
+        exact_distinct = int(np.count_nonzero(true))
+        # the exact ledger's sidecar at the same population: the number
+        # the PR-13 guard compares against its cap
+        ex = ParticipationLedger(num_clients)
+        rs2 = np.random.RandomState(SEED)
+        for rnd in range(1, ROUNDS + 1):
+            ids, w = stream(rs2, num_clients)
+            ex.observe(rnd, ids, w)
+        ex_sidecar = len(json.dumps(ex.state_dict()).encode())
+        esnap = ex.population_snapshot(ROUNDS)
+        row.update({
+            "n_total": n,
+            "cm_bound": sk._cm.epsilon * n,
+            "cm_overcount_max": float(over.max()),
+            "cm_overcount_mean": float(over.mean()),
+            "cm_within_bound_frac": float(
+                np.mean(over <= sk._cm.epsilon * n)),
+            "distinct_exact": exact_distinct,
+            "distinct_rel_err": abs(snap["distinct"] - exact_distinct)
+            / max(exact_distinct, 1),
+            "coverage_exact": esnap["coverage"],
+            "counts_p50_exact": esnap["counts_p50"],
+            "staleness_p50_est": snap["staleness_p50"],
+            "staleness_p50_exact": esnap["staleness_p50"],
+            "hh_guaranteed": int(heavy.size),
+            "hh_held": held,
+            "exact_sidecar_bytes": ex_sidecar,
+            "exact_memory_bytes": ex.memory_bytes(),
+        })
+    return row, sk
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join("runs", "population"))
+    ap.add_argument("--sizes", type=int, nargs="*",
+                    default=[10_000, 100_000, 1_000_000])
+    args = ap.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+    rows = []
+    for n in args.sizes:
+        row, sk = sweep_one(n)
+        rows.append(row)
+        print(json.dumps(row))
+        assert row["memory_bytes"] <= MEMORY_BUDGET_BYTES
+        assert row["sidecar_bytes"] <= MEMORY_BUDGET_BYTES
+    with open(os.path.join(args.out, "population_scale.jsonl"), "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in rows)
+
+    # the committed v11 stream: both ledger modes over the 10^4 arm
+    from commefficient_tpu.telemetry.run import RunTelemetry
+    from commefficient_tpu.telemetry.schema import validate_file
+    tel = RunTelemetry(args.out, "population_scale", cfg=None)
+    for mode, cls in (("sketch", PopulationLedger),
+                      ("exact", ParticipationLedger)):
+        led = (cls(10_000, seed=7) if cls is PopulationLedger
+               else cls(10_000))
+        rs = np.random.RandomState(SEED)
+        for rnd in range(1, ROUNDS + 1):
+            ids, w = stream(rs, 10_000)
+            led.observe(rnd, ids, w)
+            if rnd % 50 == 0:
+                tel.population_event(
+                    snapshot=led.population_snapshot(rnd))
+    tel.close()
+    problems = validate_file(tel.path)
+    assert problems == [], problems
+    print(f"wrote {args.out}/population_scale.jsonl "
+          f"({len(rows)} arms) and a schema-valid v11 stream "
+          f"({tel.path})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

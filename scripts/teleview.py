@@ -10,10 +10,21 @@ CLI reads one stream (``summarize``/``alerts``/``clients``/
     python scripts/teleview.py summarize runs/x/telemetry.jsonl
     python scripts/teleview.py alerts runs/x/telemetry.jsonl
     python scripts/teleview.py clients runs/x/telemetry.jsonl
+    python scripts/teleview.py population runs/x/telemetry.jsonl
     python scripts/teleview.py layers runs/x/telemetry.jsonl
     python scripts/teleview.py memory runs/x/telemetry.jsonl
+    python scripts/teleview.py trend .
     python scripts/teleview.py diff old/telemetry.jsonl new/telemetry.jsonl
     python scripts/teleview.py timeline runs/x/telemetry.jsonl -o trace.json
+
+``population`` (schema v11) renders the population-scale participation
+stream (``population`` events, telemetry/population.py): the coverage/
+distinct trajectory, sample-count and staleness quantiles, the three
+heavy-hitter tables, the ledger's memory footprint and — on
+sketch-estimated streams — the count-min (eps, delta) bounds the
+estimates carry. ``trend`` tabulates the repo's ``BENCH_r*.json``
+benchmark checkpoints (img/s, MFU, the saturated and gpt2 arms, wire
+bytes, warmup seconds), tolerating every vintage's missing fields.
 
 ``layers`` (schema v10) renders the layer-wise compression attribution
 stream (``layer_signals`` events, telemetry/layer_signals.py): the
@@ -79,6 +90,11 @@ https://ui.perfetto.dev or chrome://tracing.
   bytes growing beyond ``--temp_bytes_growth``x (the de-fusion /
   re-materialization regression class), or the final ``utilization``
   ``bw_frac`` dropping more than ``--bw_frac_drop`` (absolute);
+- on schema-v11 streams, the final ``population`` coverage dropping
+  more than ``--coverage_stall`` (absolute), or the candidate stream
+  ending in a distinct-coverage stall (no new distinct participants for
+  COVERAGE_STALL_WINDOW records below saturation) the baseline does not
+  show — the sampler-reach regression class;
 - PER-CHIP throughput (the weak-scaling contract,
   scripts/scaling_curves.py): the last ``bench`` event carrying
   ``result.per_chip_items_per_s`` dropping more than ``--perchip_drop``
@@ -103,11 +119,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 try:
     # single source of truth when the package is importable...
     from commefficient_tpu.telemetry.clients import CLIENT_STAT_KEYS
+    from commefficient_tpu.telemetry.health import COVERAGE_STALL_WINDOW
     from commefficient_tpu.telemetry.layer_signals import (
         LAYER_SIGNAL_KEYS, STARVATION_MASS_SHARE, STARVATION_WIN_SHARE,
         starved_groups)
     from commefficient_tpu.telemetry.memory_ledger import (
         MEMORY_KEYS, MEMORY_LEDGER_KEYS)
+    from commefficient_tpu.telemetry.population import POPULATION_KEYS
     from commefficient_tpu.telemetry.schema import TELEMETRY_BASENAME
     from commefficient_tpu.telemetry.signals import SIGNAL_KEYS
     from commefficient_tpu.telemetry.utilization import ROOFLINE_KEYS
@@ -147,6 +165,18 @@ except ImportError:
     )
     STARVATION_MASS_SHARE = 0.05
     STARVATION_WIN_SHARE = 0.02
+    # population event fields (schema v11, telemetry/population.py) and
+    # the coverage-stall window the monitor rule fires on — literal
+    # twins pinned against the package by tests/test_population.py
+    POPULATION_KEYS = (
+        "round", "estimated", "registered", "distinct", "coverage",
+        "counts_p50", "counts_p95", "counts_max",
+        "staleness_p50", "staleness_p95", "staleness_max",
+        "obs_count_p50", "obs_count_p95", "gap_p50", "gap_p95",
+        "top_sampled", "top_loss", "top_strikes",
+        "memory_bytes", "cm_epsilon", "cm_delta", "hh_k", "sample_size",
+    )
+    COVERAGE_STALL_WINDOW = 5
 
     def starved_groups(groups, grad_mass, topk_count,
                        mass_share=STARVATION_MASS_SHARE,
@@ -417,6 +447,18 @@ def summarize(events: List[Dict[str, Any]], label: str = "") -> None:
               f"p50 {q.get('p50')} spread(p95-p5) "
               + (f"{spread:.4g}" if spread is not None else "n/a"))
 
+    pops = by_kind(events, "population")
+    if pops:
+        p = pops[-1]
+        dist = _fin(p.get("distinct"))
+        mem = _fin(p.get("memory_bytes"))
+        print(f"-- population: {len(pops)} records, "
+              f"{'SKETCH' if p.get('estimated') else 'exact'} ledger, "
+              f"coverage {(_fin(p.get('coverage')) or 0) * 100:.1f}%"
+              + (f" ({dist:.0f} distinct~)" if dist is not None else "")
+              + (f", {mem / 2**20:.1f} MiB" if mem is not None else "")
+              + " (run `teleview population` for the tables)")
+
     als = by_kind(events, "alert")
     if als:
         worst = max(als, key=lambda e: ("info", "warn", "critical").index(
@@ -550,6 +592,176 @@ def clients(events: List[Dict[str, Any]]) -> int:
         top = sorted(owners.items(), key=lambda kv: -kv[1])[:5]
         print("-- clients most often owning the round's max loss: "
               + " ".join(f"#{c}x{n}" for c, n in top))
+    return 0
+
+
+# ---------------------------------------------------------------- population
+
+
+def _stall_streak(pops: List[Dict[str, Any]]) -> int:
+    """Terminal distinct-coverage stall streak of a ``population``
+    stream — the jax-free twin of the monitor's ``coverage_stall``
+    bookkeeping (telemetry/health.py): consecutive records where the
+    round advanced but the distinct-participant estimate did not grow
+    while coverage sat below saturation (0.999). The monitor fires at
+    ``COVERAGE_STALL_WINDOW``; ``diff --coverage_stall`` reuses this."""
+    streak = 0
+    prev: Optional[Dict[str, Any]] = None
+    for e in pops:
+        cov = _fin(e.get("coverage"))
+        dist = _fin(e.get("distinct"))
+        rnd = _fin(e.get("round"))
+        if prev is not None:
+            advanced = (rnd is None or _fin(prev.get("round")) is None
+                        or rnd > _fin(prev.get("round")))
+            grew = (dist is not None
+                    and _fin(prev.get("distinct")) is not None
+                    and dist > _fin(prev.get("distinct")))
+            if cov is not None and cov >= 0.999:
+                streak = 0
+            elif not advanced:
+                pass
+            elif grew:
+                streak = 0
+            else:
+                streak += 1
+        prev = e
+    return streak
+
+
+def population(events: List[Dict[str, Any]]) -> int:
+    """Population-scale participation report (schema-v11 ``population``
+    events, telemetry/population.py): coverage/distinct trajectory,
+    sample-count and staleness quantiles, the three heavy-hitter tables
+    (most-sampled, loss-argmax, quarantine strikes), the ledger's memory
+    footprint, and — on sketch-estimated streams — the documented
+    count-min (eps, delta) error bounds. Works on exact streams too;
+    the ``estimated`` flag says which ledger wrote the numbers."""
+    pops = by_kind(events, "population")
+    if not pops:
+        print("no population events (pre-v11 stream, or "
+              "--no_client_stats)")
+        return 0
+    first, last = pops[0], pops[-1]
+    est = bool(last.get("estimated"))
+    print(f"== population: {len(pops)} records, "
+          f"{last.get('registered', '?')} registered clients, "
+          + ("SKETCH-ESTIMATED" if est else "exact") + " ledger")
+    dist = _fin(last.get("distinct"))
+    reg = _fin(last.get("registered"))
+    print(f"-- coverage {(_fin(first.get('coverage')) or 0) * 100:.1f}% -> "
+          f"{(_fin(last.get('coverage')) or 0) * 100:.1f}% "
+          f"({dist:.0f} of {reg:.0f} distinct"
+          + ("~" if est else "") + ")"
+          if dist is not None and reg is not None else
+          "-- coverage trajectory unavailable (empty ledger)")
+    streak = _stall_streak(pops)
+    if streak >= COVERAGE_STALL_WINDOW:
+        print(f"-- COVERAGE STALL: distinct flat for the last {streak} "
+              f"records (monitor fires at {COVERAGE_STALL_WINDOW})")
+
+    def q3(p50, p95, mx):
+        vals = [last.get(p50), last.get(p95), last.get(mx)]
+        return "/".join(f"{v:.4g}" if _fin(v) is not None else "-"
+                        for v in vals)
+
+    print(f"-- samples/client p50/p95/max {q3('counts_p50', 'counts_p95', 'counts_max')}"
+          f"; staleness p50/p95/max "
+          f"{q3('staleness_p50', 'staleness_p95', 'staleness_max')}")
+    oc50, oc95 = _fin(last.get("obs_count_p50")), _fin(last.get("obs_count_p95"))
+    g50, g95 = _fin(last.get("gap_p50")), _fin(last.get("gap_p95"))
+    if oc50 is not None or g50 is not None:
+        print("-- per-participation streams (P2 running quantiles): "
+              "samples/slot p50/p95 "
+              + "/".join(f"{v:.4g}" if v is not None else "-"
+                         for v in (oc50, oc95))
+              + ", revisit gap p50/p95 "
+              + "/".join(f"{v:.4g}" if v is not None else "-"
+                         for v in (g50, g95)))
+    for key, label in (("top_sampled", "most-sampled clients"),
+                       ("top_loss", "loss-argmax owners"),
+                       ("top_strikes", "quarantine strikes")):
+        top = last.get(key) or []
+        pairs = [(p[0], p[1]) for p in top
+                 if isinstance(p, (list, tuple)) and len(p) >= 2]
+        if pairs:
+            print(f"-- {label}: "
+                  + " ".join(f"#{int(c)}x{n:.0f}" for c, n in pairs[:10])
+                  + (" (counts are upper bounds)" if est else ""))
+    mem = _fin(last.get("memory_bytes"))
+    line = (f"-- ledger memory {mem / 2**20:.2f} MiB"
+            if mem is not None else "-- ledger memory n/a")
+    eps, delta = _fin(last.get("cm_epsilon")), _fin(last.get("cm_delta"))
+    if eps is not None and delta is not None:
+        line += (f"; count-min bound: overcount <= {eps:.3g}*N "
+                 f"w.p. >= {1 - delta:.3g}"
+                 f" (hh_k {last.get('hh_k')}, "
+                 f"sample {last.get('sample_size')})")
+    print(line)
+    return 0
+
+
+# --------------------------------------------------------------------- trend
+
+
+def trend(path: str) -> int:
+    """Benchmark trajectory across the repo's ``BENCH_r*.json``
+    checkpoints: one row per file — cifar round throughput (img/s) and
+    MFU, the saturated-batch arm, the gpt2 arm (tok/s, MFU), the modeled
+    wire bytes when the vintage carries them, and the slowest warmup
+    parsed from the captured tail. Every column is vintage-tolerant:
+    r01 predates mfu, r02's bench crashed (parsed null), the saturated
+    and gpt2 arms appear mid-history, and no vintage so far emits wire
+    bytes — absent is '-', never a guess."""
+    import glob
+    import re
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "BENCH_*.json")))
+    else:
+        files = sorted(glob.glob(path))
+    if not files:
+        print(f"no BENCH_*.json under {path}")
+        return 1
+
+    def num(v, spec=".4g"):
+        return format(v, spec) if isinstance(v, (int, float)) else "-"
+
+    print("   file            img/s     mfu  sat img/s  sat mfu  "
+          "gpt2 tok/s  gpt2 mfu  wire MiB  warmup_s")
+    for f in files:
+        name = os.path.basename(f)
+        try:
+            with open(f) as fh:
+                d = json.load(fh)
+        except (OSError, ValueError):
+            print(f"   {name:14s} unreadable")
+            continue
+        parsed = d.get("parsed") if isinstance(d, dict) else None
+        parsed = parsed if isinstance(parsed, dict) else {}
+        sat = parsed.get("cifar_saturated") or {}
+        gpt2 = parsed.get("gpt2") or {}
+        # wire bytes: no committed vintage emits these yet; accept the
+        # names a future bench would naturally use, render '-' otherwise
+        wire = None
+        for k in ("wire_mib", "wire_bytes", "table_reduce_bytes"):
+            w = _fin(parsed.get(k))
+            if w is not None:
+                wire = w / 2**20 if k != "wire_mib" else w
+                break
+        warm = re.findall(r"warmup done in (\d+\.?\d*)s",
+                          str(d.get("tail") or ""))
+        warm_s = max((float(w) for w in warm), default=None)
+        row = (f"   {name:14s} {num(parsed.get('value'), '8.5g'):>8} "
+               f"{num(parsed.get('mfu')):>7} "
+               f"{num(sat.get('value'), '9.5g'):>9} "
+               f"{num(sat.get('mfu')):>8} "
+               f"{num(gpt2.get('value'), '10.6g'):>10} "
+               f"{num(gpt2.get('mfu')):>9} "
+               f"{num(wire, '.3g'):>9} "
+               f"{num(warm_s, '.1f'):>9}")
+        if not parsed:
+            row += f"   (rc={d.get('rc')}: bench produced no parse)"
+        print(row)
     return 0
 
 
@@ -1059,6 +1271,29 @@ def diff(a: List[Dict[str, Any]], b: List[Dict[str, Any]],
             f"{pb:.4g} (> {args.client_spread_ratio:.2f}x — the client "
             "population is diverging)")
 
+    # schema-v11 population gates: final coverage dropping more than
+    # --coverage_stall (absolute) against the baseline, or the
+    # candidate's stream ENDING in a distinct-coverage stall (streak >=
+    # COVERAGE_STALL_WINDOW, the monitor rule's window) the baseline
+    # does not show — the sampler stopped reaching new clients
+    pop_a, pop_b = by_kind(a, "population"), by_kind(b, "population")
+    if pop_a and pop_b:
+        va = _fin(pop_a[-1].get("coverage"))
+        vb = _fin(pop_b[-1].get("coverage"))
+        if va is not None and vb is not None \
+                and vb < va - args.coverage_stall:
+            problems.append(
+                f"population: final coverage {va:.3f} -> {vb:.3f} "
+                f"(drop > {args.coverage_stall:.2f} — the candidate is "
+                "reaching a smaller slice of the client population)")
+        sa, sb_ = _stall_streak(pop_a), _stall_streak(pop_b)
+        if sb_ >= COVERAGE_STALL_WINDOW > sa:
+            problems.append(
+                f"population: candidate ends in a {sb_}-record "
+                f"distinct-coverage stall (window "
+                f"{COVERAGE_STALL_WINDOW}) the baseline does not — "
+                "the client sampler stopped reaching new clients")
+
     def crit_alerts(events):
         return [e for e in by_kind(events, "alert")
                 if e.get("severity") == "critical"]
@@ -1152,6 +1387,13 @@ def main(argv=None) -> int:
     d.add_argument("--alert_slack", type=int, default=0,
                    help="critical-alert count growth tolerated (default "
                         "0: any new critical alert fails)")
+    d.add_argument("--coverage_stall", type=float, default=0.05,
+                   help="max ABSOLUTE drop of the final population "
+                        "coverage (schema-v11 population streams); the "
+                        "diff also fails when the candidate stream ends "
+                        "in a >= COVERAGE_STALL_WINDOW-record distinct-"
+                        "coverage stall the baseline does not show — "
+                        "the sampler-reach regression gate")
     al = sub.add_parser("alerts", help="postmortem alert triage "
                                        "(exit 1 on critical)")
     al.add_argument("path")
@@ -1159,6 +1401,18 @@ def main(argv=None) -> int:
                         help="per-client population trends from the "
                              "client_stats stream")
     cl.add_argument("path")
+    po = sub.add_parser("population",
+                        help="population-scale participation report "
+                             "from the schema-v11 population stream "
+                             "(sketch-estimated or exact)")
+    po.add_argument("path")
+    tr = sub.add_parser("trend",
+                        help="benchmark trajectory across BENCH_r*.json "
+                             "checkpoints (img/s, mfu, gpt2 tok/s, wire "
+                             "bytes, warmup; vintage-tolerant)")
+    tr.add_argument("path", nargs="?", default=".",
+                    help="directory holding BENCH_*.json (or a glob); "
+                         "default: current directory")
     ly = sub.add_parser("layers",
                         help="layer-wise compression attribution table "
                              "and per-group win-share trend from the "
@@ -1188,6 +1442,10 @@ def main(argv=None) -> int:
         return alerts(load_events(args.path))
     if args.cmd == "clients":
         return clients(load_events(args.path))
+    if args.cmd == "population":
+        return population(load_events(args.path))
+    if args.cmd == "trend":
+        return trend(args.path)
     if args.cmd == "layers":
         return layers(load_events(args.path))
     if args.cmd == "defense":

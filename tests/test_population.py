@@ -1,0 +1,584 @@
+"""Population-scale observability (telemetry/population.py +
+telemetry/clients.py make_ledger + the schema-v11 ``population`` event):
+every estimator against a numpy reference with its documented bound
+asserted on an adversarially skewed stream, seeded determinism and the
+bitwise checkpoint-sidecar round-trip, sketch/exact snapshot parity,
+the PR-13 sidecar size guard in both directions, the coverage_stall /
+hh_churn monitor rules, and the teleview ``population`` / ``trend`` /
+``diff --coverage_stall`` surfaces with their jax-free literal pins."""
+
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+from commefficient_tpu.telemetry.clients import (ParticipationLedger,
+                                                 make_ledger)
+from commefficient_tpu.telemetry.population import (AUTO_SKETCH_THRESHOLD,
+                                                    MEMORY_BUDGET_BYTES,
+                                                    POPULATION_KEYS,
+                                                    CountMinSketch,
+                                                    KMVSample,
+                                                    P2Quantile,
+                                                    PopulationLedger,
+                                                    SpaceSaving)
+
+ROOT = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def zipf_stream(rs, num_clients, slots):
+    """Adversarially skewed draw: a zipf head hammering a few hot ids
+    (the worst case for count-min row pollution) plus a uniform tail."""
+    hot = rs.zipf(1.5, slots // 2) % num_clients
+    cold = rs.randint(0, num_clients, slots - slots // 2)
+    ids = np.concatenate([hot, cold]).astype(np.int64)
+    return ids, rs.randint(1, 9, slots).astype(np.int64)
+
+
+# ------------------------------------------------------------- count-min
+
+
+def test_count_min_bounds_on_skewed_stream():
+    rs = np.random.RandomState(11)
+    cms = CountMinSketch(seed=3)
+    true = np.zeros(50_000, np.float64)
+    for _ in range(200):
+        ids, w = zipf_stream(rs, 50_000, 256)
+        cms.add(ids, w)
+        np.add.at(true, ids, w.astype(np.float64))
+    n = float(true.sum())
+    est = cms.query(np.arange(50_000, dtype=np.int64))
+    # one-sided estimator: NEVER undercounts...
+    assert np.all(est >= true - 1e-9)
+    # ...and overcounts <= eps*N with probability >= 1 - delta
+    frac_ok = np.mean(est - true <= cms.epsilon * n)
+    assert frac_ok >= 1.0 - cms.delta, (frac_ok, cms.delta)
+
+
+def test_count_min_deterministic_and_roundtrip():
+    streams = [zipf_stream(np.random.RandomState(5), 1000, 64)
+               for _ in range(20)]
+    a, b = CountMinSketch(seed=9), CountMinSketch(seed=9)
+    for ids, w in streams:
+        a.add(ids, w)
+        b.add(ids, w)
+    assert json.dumps(a.state_dict()) == json.dumps(b.state_dict())
+    c = CountMinSketch(seed=9)
+    c.load_state_dict(json.loads(json.dumps(a.state_dict())))
+    ids = np.arange(1000, dtype=np.int64)
+    assert np.array_equal(a.query(ids), c.query(ids))
+
+
+# ---------------------------------------------------------- space-saving
+
+
+def test_space_saving_holds_guaranteed_heavy_hitters():
+    rs = np.random.RandomState(7)
+    ss = SpaceSaving(k=64)
+    true = np.zeros(10_000, np.float64)
+    for _ in range(100):
+        ids, w = zipf_stream(rs, 10_000, 256)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        sums = np.zeros(uniq.size, np.float64)
+        np.add.at(sums, inv, w.astype(np.float64))
+        ss.offer(uniq, sums)
+        np.add.at(true, ids, w.astype(np.float64))
+    n = float(true.sum())
+    heavy = np.nonzero(true > n / ss.k)[0]
+    assert heavy.size > 0, "stream not skewed enough to test anything"
+    stored = {int(c): v for c, v in ss._counts.items()}
+    for c in heavy.tolist():
+        # any id with weight > N/K is guaranteed present, and its
+        # reported count brackets the truth: count - err <= true <= count
+        assert c in stored, c
+        err = ss._errs.get(c, 0.0)
+        assert stored[c] - err <= true[c] + 1e-9 <= stored[c] + 1e-9
+    # top(n) is (count desc, id asc) ordered [id, count, err] triples
+    top = ss.top(10)
+    assert all(top[i][1] >= top[i + 1][1] for i in range(len(top) - 1))
+
+
+def test_space_saving_exact_below_capacity_and_deterministic():
+    ss = SpaceSaving(k=8)
+    ss.offer(np.asarray([3, 1, 5]), np.asarray([2.0, 1.0, 4.0]))
+    ss.offer(np.asarray([3]), np.asarray([1.0]))
+    assert {int(c): v for c, v in ss._counts.items()} == {
+        3: 3.0, 1: 1.0, 5: 4.0}
+    assert all(e == 0.0 for e in ss._errs.values())
+    a, b = SpaceSaving(k=4), SpaceSaving(k=4)
+    rs = np.random.RandomState(2)
+    for _ in range(50):
+        ids, w = zipf_stream(rs, 100, 16)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        sums = np.zeros(uniq.size, np.float64)
+        np.add.at(sums, inv, w.astype(np.float64))
+        a.offer(uniq, sums)
+        b.offer(uniq, sums)
+    assert json.dumps(a.state_dict()) == json.dumps(b.state_dict())
+
+
+# ------------------------------------------------------------------- P2
+
+
+def test_p2_quantiles_vs_numpy():
+    rs = np.random.RandomState(13)
+    vals = rs.lognormal(2.0, 0.4, 5000)
+    for p in (0.5, 0.95):
+        q = P2Quantile(p)
+        for v in vals:
+            q.add(float(v))
+        ref = float(np.percentile(vals, p * 100))
+        assert abs(q.value() - ref) <= 0.05 * ref, (p, q.value(), ref)
+
+
+def test_p2_exact_small_and_roundtrip():
+    q = P2Quantile(0.5)
+    for v in (3.0, 1.0, 2.0):
+        q.add(v)
+    assert q.value() == 2.0  # exact until the 5-marker regime
+    rs = np.random.RandomState(1)
+    for v in rs.rand(100):
+        q.add(float(v))
+    r = P2Quantile(0.5)
+    r.load_state_dict(json.loads(json.dumps(q.state_dict())))
+    assert r.value() == q.value()
+    r.add(0.5)
+    q.add(0.5)
+    assert r.value() == q.value()
+
+
+# ------------------------------------------------------------------ KMV
+
+
+def test_kmv_distinct_exact_below_capacity():
+    kmv = KMVSample(size=128, seed=0)
+    kmv.observe(1, np.arange(50, dtype=np.int64),
+                np.ones(50, np.float64))
+    assert kmv.distinct() == 50.0
+
+
+def test_kmv_distinct_estimate_within_bound():
+    rs = np.random.RandomState(3)
+    kmv = KMVSample(size=1024, seed=4)
+    seen = set()
+    for rnd in range(1, 120):
+        ids = rs.randint(0, 80_000, 512).astype(np.int64)
+        uniq = np.unique(ids)
+        kmv.observe(rnd, uniq, np.ones(uniq.size, np.float64))
+        seen.update(uniq.tolist())
+    rel = abs(kmv.distinct() - len(seen)) / len(seen)
+    assert rel <= 5.0 / np.sqrt(kmv.size), (kmv.distinct(), len(seen))
+
+
+def test_kmv_roundtrip_bitwise_and_exact_member_counts():
+    rs = np.random.RandomState(6)
+    a = KMVSample(size=64, seed=8)
+    for rnd in range(1, 40):
+        ids = np.unique(rs.randint(0, 500, 32).astype(np.int64))
+        a.observe(rnd, ids, np.full(ids.size, 2.0))
+    b = KMVSample(size=64, seed=8)
+    b.load_state_dict(json.loads(json.dumps(a.state_dict())))
+    assert json.dumps(a.state_dict()) == json.dumps(b.state_dict())
+    ids = np.unique(rs.randint(0, 500, 32).astype(np.int64))
+    a.observe(40, ids, np.full(ids.size, 2.0))
+    b.observe(40, ids, np.full(ids.size, 2.0))
+    # the heap rebuilt on load must evict identically forever after
+    assert json.dumps(a.state_dict()) == json.dumps(b.state_dict())
+    # tracked members carry EXACT cumulative weight (every observation
+    # here weighs 2.0, so every sampled count is a multiple of it)
+    assert np.all(np.mod(a.counts(), 2.0) == 0.0)
+
+
+# ------------------------------------------------- ledger parity + resume
+
+
+def small_streams(n_rounds=60, num_clients=400, slots=32, seed=21):
+    rs = np.random.RandomState(seed)
+    return [zipf_stream(rs, num_clients, slots) for _ in range(n_rounds)]
+
+
+def test_sketch_and_exact_snapshots_agree_on_small_population():
+    streams = small_streams()
+    sk = PopulationLedger(400, seed=2)
+    ex = ParticipationLedger(400)
+    for rnd, (ids, w) in enumerate(streams, start=1):
+        sk.observe(rnd, ids, w)
+        ex.observe(rnd, ids, w)
+    ssnap = sk.population_snapshot(len(streams))
+    esnap = ex.population_snapshot(len(streams))
+    assert tuple(ssnap) == tuple(esnap) == POPULATION_KEYS
+    assert ssnap["estimated"] is True and esnap["estimated"] is False
+    # 400 clients fit the KMV sample entirely: distinct/coverage exact
+    assert ssnap["distinct"] == esnap["distinct"]
+    assert ssnap["coverage"] == pytest.approx(esnap["coverage"])
+    assert ssnap["counts_p50"] == pytest.approx(esnap["counts_p50"])
+    assert ssnap["staleness_p50"] == pytest.approx(esnap["staleness_p50"])
+    # per-round snapshot (the client_stats participation fields) agrees
+    # too, and both carry their mode's `estimated` flag
+    s, e = sk.snapshot(len(streams)), ex.snapshot(len(streams))
+    assert s["estimated"] is True and e["estimated"] is False
+    assert s["coverage"] == pytest.approx(e["coverage"])
+
+
+def test_sketch_ledger_bitwise_resume_at_half():
+    streams = small_streams(seed=22)
+    half = len(streams) // 2
+    full = PopulationLedger(400, seed=5)
+    resumed = None
+    for rnd, (ids, w) in enumerate(streams, start=1):
+        full.observe(rnd, ids, w)
+        full.observe_loss_argmax(int(ids[0]))
+        if rnd % 7 == 0:
+            full.observe_strikes(ids[:2])
+        if resumed is not None:
+            resumed.observe(rnd, ids, w)
+            resumed.observe_loss_argmax(int(ids[0]))
+            if rnd % 7 == 0:
+                resumed.observe_strikes(ids[:2])
+        if rnd == half:
+            resumed = PopulationLedger(400, seed=5)
+            resumed.load_state_dict(
+                json.loads(json.dumps(full.state_dict())))
+    assert json.dumps(full.state_dict()) == json.dumps(
+        resumed.state_dict())
+
+
+def test_mode_mismatch_sidecars_refuse_to_load():
+    ex = ParticipationLedger(10)
+    ex.observe(1, np.asarray([1, 2]), np.asarray([3, 4]))
+    sk = PopulationLedger(10)
+    sk.observe(1, np.asarray([1, 2]), np.asarray([3, 4]))
+    with pytest.raises(ValueError, match="population_sketch"):
+        sk.load_state_dict(json.loads(json.dumps(ex.state_dict())))
+    with pytest.raises(ValueError, match="exact ledger"):
+        ex.load_state_dict(json.loads(json.dumps(sk.state_dict())))
+
+
+def test_make_ledger_policy():
+    assert isinstance(make_ledger(50, "off"), ParticipationLedger)
+    assert isinstance(make_ledger(50, "on"), PopulationLedger)
+    assert isinstance(make_ledger(AUTO_SKETCH_THRESHOLD - 1, "auto"),
+                      ParticipationLedger)
+    assert isinstance(make_ledger(AUTO_SKETCH_THRESHOLD, "auto"),
+                      PopulationLedger)
+    with pytest.raises(ValueError, match="population_sketch"):
+        make_ledger(50, "maybe")
+
+
+def test_memory_budget_is_population_independent():
+    small = PopulationLedger(1000)
+    big = PopulationLedger(10**6)
+    assert small.memory_bytes() == big.memory_bytes()
+    assert big.memory_bytes() <= MEMORY_BUDGET_BYTES
+
+
+# --------------------------------------- vectorized observe (satellite 1)
+
+
+def test_vectorized_observe_matches_per_slot_reference_loop():
+    streams = small_streams(n_rounds=30, seed=23)
+    led = ParticipationLedger(400)
+    ref_samples, ref_last = {}, {}
+    for rnd, (ids, w) in enumerate(streams, start=1):
+        led.observe(rnd, ids, w)
+        for c, n in zip(ids.tolist(), w.tolist()):
+            if n <= 0:
+                continue
+            ref_samples[int(c)] = ref_samples.get(int(c), 0) + int(n)
+            ref_last[int(c)] = rnd
+    st = led.state_dict()
+    assert {int(c): n for c, n in st["samples"].items()} == ref_samples
+    assert {int(c): r for c, r in st["last_round"].items()} == ref_last
+    snap = led.snapshot(len(streams))
+    counts = np.asarray(sorted(ref_samples.values()), np.float64)
+    assert snap["counts_max"] == counts.max()
+    assert snap["distinct_clients"] == len(ref_samples)
+
+
+def test_observe_drops_nonpositive_slots():
+    led = ParticipationLedger(10)
+    led.observe(1, np.asarray([1, 2, 3]), np.asarray([2, 0, -1]))
+    assert {int(c) for c in led.state_dict()["samples"]} == {1}
+
+
+# ------------------------------------------- PR-13 sidecar + size guard
+
+
+def test_sidecar_guard_passes_under_cap_and_fails_over(monkeypatch):
+    from commefficient_tpu.core import preempt
+
+    sk = PopulationLedger(10**6, seed=1)
+    rs = np.random.RandomState(9)
+    for rnd in range(1, 40):
+        ids, w = zipf_stream(rs, 10**6, 256)
+        sk.observe(rnd, ids, w)
+    out = preempt.collect_ledger_state(participation=sk)
+    assert len(json.dumps(out["participation"]).encode()) \
+        <= preempt.LEDGER_SIDECAR_MAX_BYTES
+    # restoring through the sidecar into a fresh runtime's ledger is
+    # bitwise — the PR-13 contract the gate replays at full scale
+    fresh = PopulationLedger(10**6, seed=1)
+    preempt.restore_ledger_state(json.loads(json.dumps(out)),
+                                 participation=fresh)
+    assert json.dumps(fresh.state_dict()) == json.dumps(sk.state_dict())
+
+    ex = ParticipationLedger(1000)
+    ex.observe(1, np.arange(1000, dtype=np.int64),
+               np.ones(1000, np.int64))
+    monkeypatch.setattr(preempt, "LEDGER_SIDECAR_MAX_BYTES", 4096)
+    with pytest.raises(ValueError, match="--population_sketch on"):
+        preempt.collect_ledger_state(participation=ex)
+    # the sketch ledger's bounded state still fits the tightened cap?
+    # no — 4 KiB is below its ~3 MiB floor: the guard applies to BOTH
+    # ledgers (it caps the sidecar, not a ledger kind)
+    with pytest.raises(ValueError):
+        preempt.collect_ledger_state(participation=sk)
+
+
+# ------------------------------------------------------- schema (v11)
+
+
+def _checker():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(ROOT, "scripts", "check_telemetry_schema.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_population_event_validates_in_both_modes():
+    from commefficient_tpu.telemetry import validate_event
+
+    streams = small_streams(n_rounds=5, seed=24)
+    for led in (PopulationLedger(400), ParticipationLedger(400)):
+        for rnd, (ids, w) in enumerate(streams, start=1):
+            led.observe(rnd, ids, w)
+        ev = {"event": "population", "t": 0.0, "seq": 0,
+              **led.population_snapshot(len(streams))}
+        assert validate_event(json.loads(json.dumps(ev))) == [], ev
+
+
+def test_client_stats_estimated_is_v11_vintage_gated():
+    from commefficient_tpu.telemetry import validate_event
+    from commefficient_tpu.telemetry.schema import FIELDS_SINCE_V11
+
+    assert FIELDS_SINCE_V11 == {"client_stats": ("estimated",)}
+    chk = _checker()
+    ev = json.loads([ln for ln in chk.sample_stream()
+                     if '"event": "client_stats"' in ln][0])
+    assert validate_event(dict(ev)) == []
+    pre = dict(ev)
+    del pre["estimated"]
+    # a v10 stream legitimately lacks the flag; a v11 stream must not
+    assert validate_event(dict(pre), version=10) == []
+    assert any("estimated" in p for p in validate_event(dict(pre)))
+
+
+def test_schema_selftest_covers_population():
+    from commefficient_tpu.telemetry import validate_lines
+
+    chk = _checker()
+    lines = chk.sample_stream()
+    assert validate_lines(lines) == []
+    assert any('"event": "population"' in ln for ln in lines)
+
+
+# ------------------------------------------------------ monitor rules
+
+
+def _pop_fields(rnd, distinct, coverage=0.5, top=None):
+    return {"round": rnd, "distinct": float(distinct),
+            "coverage": coverage,
+            "top_sampled": top if top is not None
+            else [[1, 9.0], [2, 8.0], [3, 7.0]]}
+
+
+def test_coverage_stall_rule_fires_after_window():
+    from commefficient_tpu.telemetry.health import (AnomalyMonitor,
+                                                    COVERAGE_STALL_WINDOW)
+
+    mon = AnomalyMonitor(None, action="log")
+    fired = mon.observe("population", _pop_fields(1, 100))
+    for rnd in range(2, 2 + COVERAGE_STALL_WINDOW):
+        assert not [a for a in fired if a["rule"] == "coverage_stall"]
+        fired = mon.observe("population", _pop_fields(rnd, 100))
+    stall = [a for a in fired if a["rule"] == "coverage_stall"]
+    assert len(stall) == 1
+    assert stall[0]["metric"] == "population.coverage_stall"
+    assert stall[0]["window"] == COVERAGE_STALL_WINDOW
+
+
+def test_coverage_stall_silent_at_saturation_or_growth():
+    from commefficient_tpu.telemetry.health import AnomalyMonitor
+
+    mon = AnomalyMonitor(None, action="log")
+    for rnd in range(1, 30):  # saturated universe: flat is fine
+        fired = mon.observe("population",
+                            _pop_fields(rnd, 400, coverage=1.0))
+        assert not [a for a in fired if a["rule"] == "coverage_stall"]
+    mon2 = AnomalyMonitor(None, action="log")
+    for rnd in range(1, 30):  # still discovering: never stalls
+        fired = mon2.observe("population", _pop_fields(rnd, 100 + rnd))
+        assert not [a for a in fired if a["rule"] == "coverage_stall"]
+
+
+def test_coverage_stall_streak_survives_monitor_roundtrip():
+    from commefficient_tpu.telemetry.health import (AnomalyMonitor,
+                                                    COVERAGE_STALL_WINDOW)
+
+    mon = AnomalyMonitor(None, action="log")
+    for rnd in range(1, COVERAGE_STALL_WINDOW):  # streak = WINDOW - 2
+        mon.observe("population", _pop_fields(rnd, 100))
+    mon2 = AnomalyMonitor(None, action="log")
+    mon2.load_state_dict(json.loads(json.dumps(mon.state_dict())))
+    fired = mon2.observe("population",
+                         _pop_fields(COVERAGE_STALL_WINDOW, 100))
+    assert not [a for a in fired if a["rule"] == "coverage_stall"]
+    fired = mon2.observe("population",
+                         _pop_fields(COVERAGE_STALL_WINDOW + 1, 100))
+    assert [a for a in fired if a["rule"] == "coverage_stall"], (
+        "restored streak lost — a stall straddling a resume must still "
+        "fire on schedule")
+
+
+def test_hh_churn_rule_fires_on_turnover_burst():
+    from commefficient_tpu.telemetry.health import AnomalyMonitor
+
+    mon = AnomalyMonitor(None, action="log")
+    stable = [[i, 10.0 - i] for i in range(5)]
+    for rnd in range(1, 12):  # build a quiet turnover history
+        fired = mon.observe("population",
+                            _pop_fields(rnd, 100 + rnd, top=stable))
+        assert not [a for a in fired if a["rule"] == "hh_churn"]
+    burst = [[100 + i, 10.0 - i] for i in range(5)]
+    fired = mon.observe("population",
+                        _pop_fields(12, 112, top=burst))
+    churn = [a for a in fired if a["rule"] == "hh_churn"]
+    assert len(churn) == 1
+    assert churn[0]["metric"] == "population.hh_turnover"
+    assert churn[0]["value"] == pytest.approx(1.0)
+
+
+# ------------------------------------------------------------ teleview
+
+
+def _teleview():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "teleview", os.path.join(ROOT, "scripts", "teleview.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_teleview_fallback_literals_match_package():
+    from commefficient_tpu.telemetry.health import COVERAGE_STALL_WINDOW
+
+    src = open(os.path.join(ROOT, "scripts", "teleview.py")).read()
+    block = re.search(r"POPULATION_KEYS = \((.*?)\)", src, re.S).group(1)
+    assert tuple(re.findall(r'"([a-z_0-9]+)"', block)) == POPULATION_KEYS
+    lit = re.search(r"COVERAGE_STALL_WINDOW = (\d+)", src).group(1)
+    assert int(lit) == COVERAGE_STALL_WINDOW
+
+
+def _write_population_stream(path, rounds, distinct_fn, registered=1000):
+    with open(path, "w") as f:
+        for rnd in range(rounds):
+            d = float(distinct_fn(rnd))
+            f.write(json.dumps({
+                "event": "population", "round": rnd, "estimated": True,
+                "registered": registered, "distinct": d,
+                "coverage": d / registered, "counts_p50": 2.0,
+                "counts_p95": 6.0, "counts_max": 11.0,
+                "staleness_p50": 3.0, "staleness_p95": 9.0,
+                "staleness_max": 20.0, "obs_count_p50": 8.0,
+                "obs_count_p95": 12.0, "gap_p50": 4.0, "gap_p95": 10.0,
+                "top_sampled": [[7, 9.0]], "top_loss": [[7, 3.0]],
+                "top_strikes": [], "memory_bytes": 3468800.0,
+                "cm_epsilon": 4.15e-05, "cm_delta": 0.0183,
+                "hh_k": 256, "sample_size": 4096}) + "\n")
+
+
+def test_teleview_population_view(tmp_path, capsys):
+    p = str(tmp_path / "telemetry.jsonl")
+    _write_population_stream(p, 10, lambda r: 100 + 10 * r)
+    tv = _teleview()
+    assert tv.main(["population", p]) == 0
+    out = capsys.readouterr().out
+    assert "SKETCH-ESTIMATED" in out
+    assert "most-sampled clients: #7x9" in out
+    assert "count-min bound" in out
+    assert "COVERAGE STALL" not in out
+
+
+def test_teleview_population_view_flags_terminal_stall(tmp_path, capsys):
+    p = str(tmp_path / "telemetry.jsonl")
+    _write_population_stream(p, 12, lambda r: min(100 + 10 * r, 120))
+    tv = _teleview()
+    assert tv.main(["population", p]) == 0
+    assert "COVERAGE STALL" in capsys.readouterr().out
+
+
+def test_teleview_diff_coverage_stall_gate(tmp_path, capsys):
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    c = str(tmp_path / "c.jsonl")
+    _write_population_stream(a, 10, lambda r: 100 + 10 * r)
+    _write_population_stream(b, 10, lambda r: min(100 + 10 * r, 120))
+    _write_population_stream(c, 10, lambda r: 100 + 9 * r)
+    tv = _teleview()
+    assert tv.main(["diff", a, b]) == 1
+    out = capsys.readouterr().out
+    assert "distinct-coverage stall" in out
+    assert "final coverage" in out
+    assert tv.main(["diff", a, c]) == 0  # within the 0.05 default
+
+
+def test_teleview_trend_tolerates_every_vintage(tmp_path, capsys):
+    # r01: pre-mfu vintage; r02: crashed bench (parsed null); r03: the
+    # full shape with the nested gpt2 arm and a parseable warmup tail
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "n": 1, "rc": 0, "tail": "warmup done in 75.4s\nok",
+        "parsed": {"metric": "m", "value": 9387.0, "unit": "images/sec",
+                   "vs_baseline": 4.7}}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "n": 2, "rc": 1, "tail": "Traceback ...", "parsed": None}))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({
+        "n": 3, "rc": 0,
+        "tail": "warmup done in 34.6s\nwarmup done in 106.2s",
+        "parsed": {"metric": "m", "value": 17441.3, "unit": "images/sec",
+                   "vs_baseline": 8.7, "mfu": 0.1748,
+                   "gpt2": {"metric": "g", "value": 67326.4,
+                            "unit": "tokens/sec", "vs_baseline": 15.0,
+                            "mfu": 0.263, "tokens_per_round": 32768}}}))
+    tv = _teleview()
+    assert tv.main(["trend", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    lines = {ln.split()[0]: ln for ln in out.splitlines()
+             if "BENCH_" in ln}
+    assert "9387" in lines["BENCH_r01.json"]
+    assert "rc=1" in lines["BENCH_r02.json"]
+    assert "67326" in lines["BENCH_r03.json"]
+    assert "106.2" in lines["BENCH_r03.json"]  # slowest warmup wins
+    assert tv.main(["trend", str(tmp_path / "nothing_here")]) == 1
+
+
+# ----------------------------------------------------- config + driver
+
+
+def test_fedconfig_validates_population_sketch():
+    from commefficient_tpu.config import FedConfig
+
+    base = dict(mode="uncompressed", error_type="none",
+                local_momentum=0.0, virtual_momentum=0.9,
+                weight_decay=0.0, num_workers=2, local_batch_size=2,
+                track_bytes=False, num_clients=2, num_results_train=2)
+    assert FedConfig(**base).population_sketch == "auto"
+    assert FedConfig(**base,
+                     population_sketch="on").population_sketch == "on"
+    with pytest.raises(ValueError, match="population_sketch"):
+        FedConfig(**base, population_sketch="sometimes")
